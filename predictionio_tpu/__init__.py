@@ -1,0 +1,20 @@
+"""predictionio_tpu — a TPU-native machine-learning server framework.
+
+A ground-up re-design of the capabilities of PredictionIO 0.9.2
+(reference: /root/reference, Scala/Spark) for TPU hardware:
+
+- Event collection over REST (event server, access keys, channels, webhooks).
+- Pluggable DASE engines (DataSource, Preparator, Algorithm(s), Serving,
+  Evaluation) — reference: core/src/main/scala/io/prediction/controller/.
+- Training runs compile to XLA via jax/pjit over a ``jax.sharding.Mesh``
+  (replacing the reference's Spark RDD substrate).
+- Trained engines deploy as HTTP prediction services with hot reload and a
+  feedback loop (reference: core/.../workflow/CreateServer.scala).
+- Model versioning, evaluation/tuning leaderboards, dashboard, CLI.
+
+Nothing here is a translation of the reference's Scala: data flows as
+columnar numpy/jax arrays, algorithms are pjit-compiled pure functions,
+and distribution is XLA collectives over ICI instead of Spark shuffle.
+"""
+
+__version__ = "0.1.0"

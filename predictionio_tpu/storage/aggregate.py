@@ -1,0 +1,166 @@
+"""Property aggregation: folding ``$set``/``$unset``/``$delete`` event
+streams into per-entity ``PropertyMap``s.
+
+Reference semantics (data/src/main/scala/io/prediction/data/storage/
+LEventAggregator.scala:22-123 and PEventAggregator.scala:35-209):
+
+- events are processed in ``event_time`` order per entity;
+- ``$set`` merges properties (later wins per key);
+- ``$unset`` removes the named keys (only if the entity currently exists);
+- ``$delete`` erases the entity (it may be re-created by a later ``$set``);
+- entities whose fold ends with no live DataMap are dropped;
+- first/last updated times span all special events seen for the entity.
+
+The parallel version in the reference is an ``aggregateByKey`` over a
+commutative monoid (``EventOp ++``). Here the same monoid is implemented so
+aggregation can run as an associative merge over event shards — the
+host-side analog of a segment reduce — and is therefore safe to parallelize
+over processes or to fold incrementally as events stream in.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from datetime import datetime
+from typing import Any, Iterable, Iterator
+
+from .datamap import DataMap, PropertyMap
+from .event import Event
+
+__all__ = ["EventOp", "aggregate_properties", "aggregate_properties_single"]
+
+
+def _millis(t: datetime) -> float:
+    return t.timestamp()
+
+
+@dataclass
+class _PropTime:
+    value: Any
+    t: float
+
+
+@dataclass
+class EventOp:
+    """The aggregation monoid (reference PEventAggregator.scala:95-190).
+
+    Tracks, independently: last-write-wins ``$set`` fields, latest ``$unset``
+    time per key, latest ``$delete`` time, and first/last updated times.
+    ``merge`` is associative and commutative, so shard-level partial
+    aggregates combine in any order.
+    """
+
+    set_fields: dict[str, _PropTime] = field(default_factory=dict)
+    set_t: float | None = None  # latest $set time (fields may be empty)
+    unset_fields: dict[str, float] = field(default_factory=dict)
+    delete_t: float | None = None
+    first_updated: datetime | None = None
+    last_updated: datetime | None = None
+
+    @staticmethod
+    def from_event(e: Event) -> "EventOp":
+        op = EventOp()
+        t = _millis(e.event_time)
+        if e.event == "$set":
+            op.set_fields = {k: _PropTime(v, t) for k, v in e.properties.items()}
+            op.set_t = t
+        elif e.event == "$unset":
+            op.unset_fields = {k: t for k in e.properties.key_set()}
+        elif e.event == "$delete":
+            op.delete_t = t
+        else:
+            return op  # non-special events do not touch properties
+        op.first_updated = e.event_time
+        op.last_updated = e.event_time
+        return op
+
+    def merge(self, other: "EventOp") -> "EventOp":
+        out = EventOp()
+        # $set: per-key last-write-wins
+        out.set_fields = dict(self.set_fields)
+        for k, pt in other.set_fields.items():
+            cur = out.set_fields.get(k)
+            if cur is None or pt.t > cur.t:
+                out.set_fields[k] = pt
+        out.set_t = _max_opt(self.set_t, other.set_t)
+        # $unset: latest unset time per key
+        out.unset_fields = dict(self.unset_fields)
+        for k, t in other.unset_fields.items():
+            out.unset_fields[k] = max(t, out.unset_fields.get(k, float("-inf")))
+        out.delete_t = _max_opt(self.delete_t, other.delete_t)
+        out.first_updated = _min_opt_dt(self.first_updated, other.first_updated)
+        out.last_updated = _max_opt_dt(self.last_updated, other.last_updated)
+        return out
+
+    def to_property_map(self) -> PropertyMap | None:
+        """Resolve the monoid into the final entity state (reference
+        PEventAggregator.scala:150-190 ``toPropertyMap``)."""
+        if self.set_t is None:
+            return None
+        if self.delete_t is not None and self.delete_t >= self.set_t:
+            # entity deleted after (or at) the last $set
+            return None
+        fields: dict[str, Any] = {}
+        for k, pt in self.set_fields.items():
+            if self.delete_t is not None and self.delete_t >= pt.t:
+                continue
+            unset_t = self.unset_fields.get(k)
+            if unset_t is not None and unset_t >= pt.t:
+                continue
+            fields[k] = pt.value
+        assert self.first_updated is not None and self.last_updated is not None
+        return PropertyMap(fields, self.first_updated, self.last_updated)
+
+
+def _max_opt(a: float | None, b: float | None) -> float | None:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return max(a, b)
+
+
+def _min_opt_dt(a: datetime | None, b: datetime | None) -> datetime | None:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return min(a, b)
+
+
+def _max_opt_dt(a: datetime | None, b: datetime | None) -> datetime | None:
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return max(a, b)
+
+
+def aggregate_properties(events: Iterable[Event]) -> dict[str, PropertyMap]:
+    """Fold special events into per-entity PropertyMaps
+    (reference LEventAggregator.aggregateProperties, LEventAggregator.scala:24-44).
+    Entities whose final state is deleted/never-set are dropped."""
+    ops: dict[str, EventOp] = {}
+    for e in events:
+        if e.event not in ("$set", "$unset", "$delete"):
+            continue
+        op = EventOp.from_event(e)
+        prev = ops.get(e.entity_id)
+        ops[e.entity_id] = op if prev is None else prev.merge(op)
+    out: dict[str, PropertyMap] = {}
+    for entity_id, op in ops.items():
+        pm = op.to_property_map()
+        if pm is not None:
+            out[entity_id] = pm
+    return out
+
+
+def aggregate_properties_single(events: Iterator[Event]) -> PropertyMap | None:
+    """Single-entity variant (LEventAggregator.scala:46-64)."""
+    acc: EventOp | None = None
+    for e in events:
+        if e.event not in ("$set", "$unset", "$delete"):
+            continue
+        op = EventOp.from_event(e)
+        acc = op if acc is None else acc.merge(op)
+    return acc.to_property_map() if acc is not None else None

@@ -1,0 +1,197 @@
+"""Event-store SPI.
+
+The query surface mirrors the reference's ``LEvents``/``PEvents`` traits
+(reference: data/src/main/scala/io/prediction/data/storage/LEvents.scala:31-451,
+PEvents.scala:30-138): time-range, entity, event-name and target-entity
+filters, limit and reversal, plus ``$set``-fold property aggregation.
+
+Differences from the reference, by design:
+
+- One backend class serves both the "local" (iterator) and "parallel" roles.
+  The parallel read path is ``find_frame`` which returns a columnar
+  ``EventFrame`` (see frame.py) instead of an ``RDD[Event]`` — the frame is
+  what gets sharded onto the device mesh.
+- Synchronous core methods; the event server wraps them in worker threads.
+  (The reference's Futures exist because HBase RPCs are slow; the built-in
+  backends here are in-process.)
+
+Target-entity filters use the ``ANY`` sentinel: ``ANY`` = no restriction,
+``None`` = event must have no target entity, a string = exact match —
+the reference's ``None`` / ``Some(None)`` / ``Some(Some(x))`` triple
+(LEvents.scala:111-118).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+from datetime import datetime
+from typing import Any, Iterator, Sequence
+
+from .aggregate import aggregate_properties, aggregate_properties_single
+from .datamap import PropertyMap
+from .event import Event
+from .frame import EventFrame
+
+__all__ = ["ANY", "EventBackend", "EventQuery", "StorageError"]
+
+
+class StorageError(RuntimeError):
+    pass
+
+
+class _Any:
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self) -> str:
+        return "ANY"
+
+
+#: no-restriction sentinel for target-entity filters
+ANY: Any = _Any()
+
+
+@dataclass(frozen=True)
+class EventQuery:
+    """All find() filters in one value (hashable, usable as a memo key)."""
+
+    app_id: int
+    channel_id: int | None = None
+    start_time: datetime | None = None
+    until_time: datetime | None = None
+    entity_type: str | None = None
+    entity_id: str | None = None
+    event_names: tuple[str, ...] | None = None
+    target_entity_type: Any = ANY
+    target_entity_id: Any = ANY
+    limit: int | None = None
+    reversed: bool = False
+
+    def matches(self, e: Event) -> bool:
+        if self.start_time is not None and e.event_time < self.start_time:
+            return False
+        if self.until_time is not None and e.event_time >= self.until_time:
+            return False
+        if self.entity_type is not None and e.entity_type != self.entity_type:
+            return False
+        if self.entity_id is not None and e.entity_id != self.entity_id:
+            return False
+        if self.event_names is not None and e.event not in self.event_names:
+            return False
+        if self.target_entity_type is not ANY:
+            if e.target_entity_type != self.target_entity_type:
+                return False
+        if self.target_entity_id is not ANY:
+            if e.target_entity_id != self.target_entity_id:
+                return False
+        return True
+
+
+class EventBackend(abc.ABC):
+    """Abstract event store. One instance manages all apps/channels of one
+    configured EVENTDATA source (reference: Storage.getLEvents,
+    Storage.scala:283-296)."""
+
+    # -- lifecycle (LEvents.scala:44-68) ----------------------------------
+    @abc.abstractmethod
+    def init_app(self, app_id: int, channel_id: int | None = None) -> bool:
+        """Initialize storage for an app/channel (idempotent)."""
+
+    @abc.abstractmethod
+    def remove_app(self, app_id: int, channel_id: int | None = None) -> bool:
+        """Remove all events of an app/channel."""
+
+    def close(self) -> None:
+        pass
+
+    # -- writes -----------------------------------------------------------
+    @abc.abstractmethod
+    def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
+        """Insert one event, returning its assigned event id."""
+
+    def insert_batch(
+        self, events: Sequence[Event], app_id: int, channel_id: int | None = None
+    ) -> list[str]:
+        """Bulk insert (the import path; reference tools/imprt/FileToEvents
+        uses PEvents.write). Backends may override for a faster path."""
+        return [self.insert(e, app_id, channel_id) for e in events]
+
+    # -- point reads / deletes (LEvents.scala:71-103) ---------------------
+    @abc.abstractmethod
+    def get(self, event_id: str, app_id: int, channel_id: int | None = None) -> Event | None:
+        ...
+
+    @abc.abstractmethod
+    def delete(self, event_id: str, app_id: int, channel_id: int | None = None) -> bool:
+        ...
+
+    # -- queries ----------------------------------------------------------
+    @abc.abstractmethod
+    def find(self, query: EventQuery) -> Iterator[Event]:
+        """Filtered scan ordered by event_time (descending if
+        ``query.reversed``), truncated to ``query.limit`` (None or -1 = all)."""
+
+    def find_frame(self, query: EventQuery) -> EventFrame:
+        """Columnar scan — the parallel/TPU read path (PEvents.find analog).
+        Limit/reversed are ignored (full filtered scan), as in the
+        reference's parallel API which has no limit (PEvents.scala:70-80)."""
+        q = EventQuery(**{**query.__dict__, "limit": None, "reversed": False})
+        return EventFrame.from_events(self.find(q))
+
+    def aggregate_properties(
+        self,
+        app_id: int,
+        *,
+        entity_type: str,
+        channel_id: int | None = None,
+        start_time: datetime | None = None,
+        until_time: datetime | None = None,
+        required: Sequence[str] | None = None,
+    ) -> dict[str, PropertyMap]:
+        """$set/$unset/$delete fold per entity (LEvents.scala:153-194)."""
+        events = self.find(
+            EventQuery(
+                app_id=app_id,
+                channel_id=channel_id,
+                entity_type=entity_type,
+                start_time=start_time,
+                until_time=until_time,
+                event_names=("$set", "$unset", "$delete"),
+            )
+        )
+        result = aggregate_properties(events)
+        if required:
+            result = {
+                k: v
+                for k, v in result.items()
+                if all(r in v for r in required)
+            }
+        return result
+
+    def aggregate_properties_of_entity(
+        self,
+        app_id: int,
+        entity_type: str,
+        entity_id: str,
+        channel_id: int | None = None,
+        start_time: datetime | None = None,
+        until_time: datetime | None = None,
+    ) -> PropertyMap | None:
+        """Single-entity fold (LEvents.scala:196-230)."""
+        events = self.find(
+            EventQuery(
+                app_id=app_id,
+                channel_id=channel_id,
+                entity_type=entity_type,
+                entity_id=entity_id,
+                start_time=start_time,
+                until_time=until_time,
+                event_names=("$set", "$unset", "$delete"),
+            )
+        )
+        return aggregate_properties_single(events)

@@ -1,0 +1,471 @@
+"""Metadata entities and their DAOs.
+
+The reference keeps framework metadata (apps, access keys, channels, engine
+manifests/instances, evaluation instances) in Elasticsearch/MongoDB behind
+per-entity DAO traits (reference: data/src/main/scala/io/prediction/data/
+storage/{Apps,AccessKeys,Channels,EngineManifests,EngineInstances,
+EvaluationInstances}.scala). Here a single SQLite database holds all
+metadata tables — one file, transactional, zero services; ``:memory:`` for
+tests. Entities are frozen dataclasses serialized to/from JSON columns.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import secrets
+import sqlite3
+import threading
+from dataclasses import asdict, dataclass, field, replace
+from datetime import datetime, timezone
+
+__all__ = [
+    "App", "AccessKey", "Channel", "EngineManifest", "EngineInstance",
+    "EvaluationInstance", "Model", "MetadataStore", "CHANNEL_NAME_RE",
+]
+
+#: reference Channels.scala:35-39
+CHANNEL_NAME_RE = re.compile(r"^[a-zA-Z0-9-]{1,16}$")
+
+
+@dataclass(frozen=True)
+class App:
+    id: int
+    name: str
+    description: str | None = None
+
+
+@dataclass(frozen=True)
+class AccessKey:
+    key: str
+    appid: int
+    events: tuple[str, ...] = ()  # empty = all events allowed (AccessKeys.scala:27-34)
+
+
+@dataclass(frozen=True)
+class Channel:
+    id: int
+    name: str
+    appid: int
+
+    @staticmethod
+    def is_valid_name(s: str) -> bool:
+        return bool(CHANNEL_NAME_RE.match(s))
+
+
+@dataclass(frozen=True)
+class EngineManifest:
+    """Registered engine build (reference EngineManifests.scala:33-43);
+    ``files`` are the engine's code paths (module dirs, not jars)."""
+    id: str
+    version: str
+    name: str
+    description: str | None = None
+    files: tuple[str, ...] = ()
+    engine_factory: str = ""
+
+
+def _utcnow() -> datetime:
+    return datetime.now(timezone.utc)
+
+
+@dataclass(frozen=True)
+class EngineInstance:
+    """One training/evaluation run's record (EngineInstances.scala:47-67).
+    Status lifecycle: INIT -> TRAINING -> COMPLETED | ABORTED."""
+    id: str = ""
+    status: str = "INIT"
+    start_time: datetime = field(default_factory=_utcnow)
+    end_time: datetime = field(default_factory=_utcnow)
+    engine_id: str = ""
+    engine_version: str = ""
+    engine_variant: str = ""
+    engine_factory: str = ""
+    evaluator_class: str = ""
+    batch: str = ""
+    env: dict = field(default_factory=dict)
+    backend_conf: dict = field(default_factory=dict)  # reference: sparkConf
+    data_source_params: str = ""
+    preparator_params: str = ""
+    algorithms_params: str = ""
+    serving_params: str = ""
+    evaluator_params: str = ""
+    evaluator_results: str = ""
+    evaluator_results_html: str = ""
+    evaluator_results_json: str = ""
+
+
+@dataclass(frozen=True)
+class EvaluationInstance:
+    """(EvaluationInstances.scala:38-50)"""
+    id: str = ""
+    status: str = ""
+    start_time: datetime = field(default_factory=_utcnow)
+    end_time: datetime = field(default_factory=_utcnow)
+    evaluation_class: str = ""
+    engine_params_generator_class: str = ""
+    batch: str = ""
+    env: dict = field(default_factory=dict)
+    backend_conf: dict = field(default_factory=dict)
+    evaluator_results: str = ""
+    evaluator_results_html: str = ""
+    evaluator_results_json: str = ""
+
+
+@dataclass(frozen=True)
+class Model:
+    """Serialized model blob keyed by engine-instance id (Models.scala:30)."""
+    id: str
+    models: bytes
+
+
+_DT_FIELDS = {"start_time", "end_time"}
+
+
+def _utc_sort_key(t: datetime) -> str:
+    """Normalized-UTC isoformat for the indexed start_time columns, so
+    lexicographic ORDER BY matches chronological order across offsets."""
+    if t.tzinfo is None:
+        t = t.replace(tzinfo=timezone.utc)
+    return t.astimezone(timezone.utc).isoformat()
+
+
+def _ser(obj) -> str:
+    d = asdict(obj)
+    for k in _DT_FIELDS & d.keys():
+        d[k] = d[k].isoformat()
+    return json.dumps(d)
+
+
+def _deser(cls, s: str):
+    d = json.loads(s)
+    for k in _DT_FIELDS & d.keys():
+        d[k] = datetime.fromisoformat(d[k])
+    for k, v in list(d.items()):
+        if isinstance(v, list):
+            d[k] = tuple(v)
+    return cls(**d)
+
+
+class MetadataStore:
+    """All metadata DAOs over one SQLite database.
+
+    JSON-document tables with a few indexed columns — the same shape as the
+    reference's ES documents (e.g. ESEngineInstances.scala:40-90) without
+    the cluster.
+    """
+
+    def __init__(self, path: str = ":memory:"):
+        self._path = path
+        self._local = threading.local()
+        self._lock = threading.RLock()
+        self._init_schema()
+
+    def _conn(self) -> sqlite3.Connection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self._path, timeout=30.0)
+            conn.execute("PRAGMA journal_mode=WAL")
+            self._local.conn = conn
+        return conn
+
+    def _init_schema(self) -> None:
+        c = self._conn()
+        with self._lock:
+            c.executescript(
+                """
+                CREATE TABLE IF NOT EXISTS apps (
+                  id INTEGER PRIMARY KEY AUTOINCREMENT, name TEXT UNIQUE, doc TEXT);
+                CREATE TABLE IF NOT EXISTS access_keys (
+                  key TEXT PRIMARY KEY, appid INTEGER, doc TEXT);
+                CREATE TABLE IF NOT EXISTS channels (
+                  id INTEGER PRIMARY KEY AUTOINCREMENT, appid INTEGER, name TEXT, doc TEXT,
+                  UNIQUE(appid, name));
+                CREATE TABLE IF NOT EXISTS engine_manifests (
+                  id TEXT, version TEXT, doc TEXT, PRIMARY KEY (id, version));
+                CREATE TABLE IF NOT EXISTS engine_instances (
+                  id TEXT PRIMARY KEY, status TEXT, engine_id TEXT,
+                  engine_version TEXT, engine_variant TEXT, start_time TEXT, doc TEXT);
+                CREATE TABLE IF NOT EXISTS evaluation_instances (
+                  id TEXT PRIMARY KEY, status TEXT, start_time TEXT, doc TEXT);
+                CREATE TABLE IF NOT EXISTS models (
+                  id TEXT PRIMARY KEY, blob BLOB);
+                CREATE TABLE IF NOT EXISTS sequences (
+                  name TEXT PRIMARY KEY, value INTEGER);
+                """
+            )
+            c.commit()
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+
+    # -- sequences (ESSequences analog) -----------------------------------
+    def next_id(self, name: str) -> int:
+        c = self._conn()
+        with self._lock:
+            c.execute(
+                "INSERT INTO sequences VALUES (?, 1) "
+                "ON CONFLICT(name) DO UPDATE SET value = value + 1",
+                (name,),
+            )
+            (v,) = c.execute("SELECT value FROM sequences WHERE name=?", (name,)).fetchone()
+            c.commit()
+            return int(v)
+
+    # -- apps (Apps.scala:41-70) ------------------------------------------
+    def app_insert(self, name: str, description: str | None = None) -> App | None:
+        c = self._conn()
+        with self._lock:
+            try:
+                cur = c.execute(
+                    "INSERT INTO apps (name, doc) VALUES (?, ?)", (name, "")
+                )
+            except sqlite3.IntegrityError:
+                return None
+            app = App(id=cur.lastrowid, name=name, description=description)
+            c.execute("UPDATE apps SET doc=? WHERE id=?", (_ser(app), app.id))
+            c.commit()
+            return app
+
+    def app_get(self, app_id: int) -> App | None:
+        row = self._conn().execute("SELECT doc FROM apps WHERE id=?", (app_id,)).fetchone()
+        return _deser(App, row[0]) if row else None
+
+    def app_get_by_name(self, name: str) -> App | None:
+        row = self._conn().execute("SELECT doc FROM apps WHERE name=?", (name,)).fetchone()
+        return _deser(App, row[0]) if row else None
+
+    def app_get_all(self) -> list[App]:
+        return [_deser(App, r[0]) for r in self._conn().execute("SELECT doc FROM apps ORDER BY id")]
+
+    def app_update(self, app: App) -> bool:
+        c = self._conn()
+        with self._lock:
+            cur = c.execute(
+                "UPDATE apps SET name=?, doc=? WHERE id=?", (app.name, _ser(app), app.id)
+            )
+            c.commit()
+            return cur.rowcount > 0
+
+    def app_delete(self, app_id: int) -> bool:
+        c = self._conn()
+        with self._lock:
+            cur = c.execute("DELETE FROM apps WHERE id=?", (app_id,))
+            c.commit()
+            return cur.rowcount > 0
+
+    # -- access keys (AccessKeys.scala:37-77) -----------------------------
+    def access_key_insert(self, appid: int, events: tuple[str, ...] = (), key: str | None = None) -> AccessKey:
+        ak = AccessKey(key=key or secrets.token_urlsafe(32), appid=appid, events=tuple(events))
+        c = self._conn()
+        with self._lock:
+            c.execute(
+                "INSERT INTO access_keys VALUES (?, ?, ?)", (ak.key, appid, _ser(ak))
+            )
+            c.commit()
+        return ak
+
+    def access_key_get(self, key: str) -> AccessKey | None:
+        row = self._conn().execute("SELECT doc FROM access_keys WHERE key=?", (key,)).fetchone()
+        return _deser(AccessKey, row[0]) if row else None
+
+    def access_key_get_all(self) -> list[AccessKey]:
+        return [_deser(AccessKey, r[0]) for r in self._conn().execute("SELECT doc FROM access_keys")]
+
+    def access_key_get_by_appid(self, appid: int) -> list[AccessKey]:
+        return [
+            _deser(AccessKey, r[0])
+            for r in self._conn().execute("SELECT doc FROM access_keys WHERE appid=?", (appid,))
+        ]
+
+    def access_key_delete(self, key: str) -> bool:
+        c = self._conn()
+        with self._lock:
+            cur = c.execute("DELETE FROM access_keys WHERE key=?", (key,))
+            c.commit()
+            return cur.rowcount > 0
+
+    # -- channels (Channels.scala:44-71) ----------------------------------
+    def channel_insert(self, appid: int, name: str) -> Channel | None:
+        if not Channel.is_valid_name(name):
+            return None
+        c = self._conn()
+        with self._lock:
+            try:
+                cur = c.execute(
+                    "INSERT INTO channels (appid, name, doc) VALUES (?, ?, ?)",
+                    (appid, name, ""),
+                )
+            except sqlite3.IntegrityError:
+                return None
+            ch = Channel(id=cur.lastrowid, name=name, appid=appid)
+            c.execute("UPDATE channels SET doc=? WHERE id=?", (_ser(ch), ch.id))
+            c.commit()
+            return ch
+
+    def channel_get(self, channel_id: int) -> Channel | None:
+        row = self._conn().execute("SELECT doc FROM channels WHERE id=?", (channel_id,)).fetchone()
+        return _deser(Channel, row[0]) if row else None
+
+    def channel_get_by_appid(self, appid: int) -> list[Channel]:
+        return [
+            _deser(Channel, r[0])
+            for r in self._conn().execute(
+                "SELECT doc FROM channels WHERE appid=? ORDER BY id", (appid,)
+            )
+        ]
+
+    def channel_delete(self, channel_id: int) -> bool:
+        c = self._conn()
+        with self._lock:
+            cur = c.execute("DELETE FROM channels WHERE id=?", (channel_id,))
+            c.commit()
+            return cur.rowcount > 0
+
+    # -- engine manifests (EngineManifests.scala:47-77) -------------------
+    def engine_manifest_insert(self, m: EngineManifest) -> None:
+        c = self._conn()
+        with self._lock:
+            c.execute(
+                "INSERT OR REPLACE INTO engine_manifests VALUES (?, ?, ?)",
+                (m.id, m.version, _ser(m)),
+            )
+            c.commit()
+
+    def engine_manifest_get(self, id: str, version: str) -> EngineManifest | None:
+        row = self._conn().execute(
+            "SELECT doc FROM engine_manifests WHERE id=? AND version=?", (id, version)
+        ).fetchone()
+        return _deser(EngineManifest, row[0]) if row else None
+
+    def engine_manifest_get_all(self) -> list[EngineManifest]:
+        return [
+            _deser(EngineManifest, r[0])
+            for r in self._conn().execute("SELECT doc FROM engine_manifests")
+        ]
+
+    def engine_manifest_delete(self, id: str, version: str) -> bool:
+        c = self._conn()
+        with self._lock:
+            cur = c.execute(
+                "DELETE FROM engine_manifests WHERE id=? AND version=?", (id, version)
+            )
+            c.commit()
+            return cur.rowcount > 0
+
+    # -- engine instances (EngineInstances.scala:72-130) ------------------
+    def engine_instance_insert(self, i: EngineInstance) -> str:
+        if not i.id:
+            i = replace(i, id=f"ei_{self.next_id('engine_instances'):08d}")
+        c = self._conn()
+        with self._lock:
+            c.execute(
+                "INSERT OR REPLACE INTO engine_instances VALUES (?,?,?,?,?,?,?)",
+                (i.id, i.status, i.engine_id, i.engine_version, i.engine_variant,
+                 _utc_sort_key(i.start_time), _ser(i)),
+            )
+            c.commit()
+        return i.id
+
+    def engine_instance_get(self, id: str) -> EngineInstance | None:
+        row = self._conn().execute(
+            "SELECT doc FROM engine_instances WHERE id=?", (id,)
+        ).fetchone()
+        return _deser(EngineInstance, row[0]) if row else None
+
+    def engine_instance_get_all(self) -> list[EngineInstance]:
+        return [
+            _deser(EngineInstance, r[0])
+            for r in self._conn().execute("SELECT doc FROM engine_instances")
+        ]
+
+    def engine_instance_get_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> list[EngineInstance]:
+        """Completed instances, latest first (EngineInstances.scala:100-110)."""
+        rows = self._conn().execute(
+            "SELECT doc FROM engine_instances WHERE status='COMPLETED' AND "
+            "engine_id=? AND engine_version=? AND engine_variant=? "
+            "ORDER BY start_time DESC",
+            (engine_id, engine_version, engine_variant),
+        )
+        return [_deser(EngineInstance, r[0]) for r in rows]
+
+    def engine_instance_get_latest_completed(
+        self, engine_id: str, engine_version: str, engine_variant: str
+    ) -> EngineInstance | None:
+        done = self.engine_instance_get_completed(engine_id, engine_version, engine_variant)
+        return done[0] if done else None
+
+    def engine_instance_update(self, i: EngineInstance) -> None:
+        self.engine_instance_insert(i)
+
+    def engine_instance_delete(self, id: str) -> bool:
+        c = self._conn()
+        with self._lock:
+            cur = c.execute("DELETE FROM engine_instances WHERE id=?", (id,))
+            c.commit()
+            return cur.rowcount > 0
+
+    # -- evaluation instances (EvaluationInstances.scala:55-90) -----------
+    def evaluation_instance_insert(self, i: EvaluationInstance) -> str:
+        if not i.id:
+            i = replace(i, id=f"ev_{self.next_id('evaluation_instances'):08d}")
+        c = self._conn()
+        with self._lock:
+            c.execute(
+                "INSERT OR REPLACE INTO evaluation_instances VALUES (?,?,?,?)",
+                (i.id, i.status, _utc_sort_key(i.start_time), _ser(i)),
+            )
+            c.commit()
+        return i.id
+
+    def evaluation_instance_get(self, id: str) -> EvaluationInstance | None:
+        row = self._conn().execute(
+            "SELECT doc FROM evaluation_instances WHERE id=?", (id,)
+        ).fetchone()
+        return _deser(EvaluationInstance, row[0]) if row else None
+
+    def evaluation_instance_get_all(self) -> list[EvaluationInstance]:
+        return [
+            _deser(EvaluationInstance, r[0])
+            for r in self._conn().execute("SELECT doc FROM evaluation_instances")
+        ]
+
+    def evaluation_instance_get_completed(self) -> list[EvaluationInstance]:
+        rows = self._conn().execute(
+            "SELECT doc FROM evaluation_instances WHERE status='EVALCOMPLETED' "
+            "ORDER BY start_time DESC"
+        )
+        return [_deser(EvaluationInstance, r[0]) for r in rows]
+
+    def evaluation_instance_update(self, i: EvaluationInstance) -> None:
+        self.evaluation_instance_insert(i)
+
+    def evaluation_instance_delete(self, id: str) -> bool:
+        c = self._conn()
+        with self._lock:
+            cur = c.execute("DELETE FROM evaluation_instances WHERE id=?", (id,))
+            c.commit()
+            return cur.rowcount > 0
+
+    # -- model blobs (Models.scala:36-50) ---------------------------------
+    def model_insert(self, m: Model) -> None:
+        c = self._conn()
+        with self._lock:
+            c.execute("INSERT OR REPLACE INTO models VALUES (?, ?)", (m.id, m.models))
+            c.commit()
+
+    def model_get(self, id: str) -> Model | None:
+        row = self._conn().execute("SELECT blob FROM models WHERE id=?", (id,)).fetchone()
+        return Model(id=id, models=row[0]) if row else None
+
+    def model_delete(self, id: str) -> bool:
+        c = self._conn()
+        with self._lock:
+            cur = c.execute("DELETE FROM models WHERE id=?", (id,))
+            c.commit()
+            return cur.rowcount > 0

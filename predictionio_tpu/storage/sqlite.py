@@ -1,0 +1,237 @@
+"""SQLite event store backend — the durable single-node EVENTDATA store.
+
+Replaces the role of the reference's HBase event backend (reference:
+data/src/main/scala/io/prediction/data/storage/hbase/HBEventsUtil.scala,
+HBLEvents.scala): one table per (app, channel) named
+``events_<appId>[_<channelId>]`` like the reference's
+``pio_event:events_<appId>_<channelId>`` naming (HBEventsUtil.scala:51-58),
+rows keyed by a time-ordered synthetic key, with indexed columns for the
+standard filters. Properties ride as JSON text.
+
+SQLite (WAL mode) gives durable multi-reader/single-writer semantics in one
+file with zero external services — the right call for a single host; the
+storage registry lets a real distributed backend plug in behind the same
+``EventBackend`` SPI without touching callers.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import uuid
+from datetime import datetime, timezone
+from typing import Iterator, Sequence
+
+from .datamap import DataMap
+from .event import Event
+from .events_base import ANY, EventBackend, EventQuery, StorageError
+
+__all__ = ["SQLiteEvents"]
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS {table} (
+  event_id TEXT PRIMARY KEY,
+  event TEXT NOT NULL,
+  entity_type TEXT NOT NULL,
+  entity_id TEXT NOT NULL,
+  target_entity_type TEXT,
+  target_entity_id TEXT,
+  properties TEXT NOT NULL,
+  event_time REAL NOT NULL,
+  tags TEXT NOT NULL,
+  pr_id TEXT,
+  creation_time REAL NOT NULL,
+  seq INTEGER
+);
+CREATE INDEX IF NOT EXISTS {table}_time ON {table} (event_time, seq);
+CREATE INDEX IF NOT EXISTS {table}_entity ON {table} (entity_type, entity_id, event_time);
+"""
+
+
+def _table_name(app_id: int, channel_id: int | None) -> str:
+    if channel_id is None:
+        return f"events_{app_id}"
+    return f"events_{app_id}_{channel_id}"
+
+
+class SQLiteEvents(EventBackend):
+    def __init__(self, config: dict | None = None):
+        config = config or {}
+        self._path = config.get("path", ":memory:")
+        self._local = threading.local()
+        self._lock = threading.RLock()
+        self._known_tables: set[str] = set()
+        self._seq = 0
+
+    def _conn(self) -> sqlite3.Connection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self._path, timeout=30.0)
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute("PRAGMA synchronous=NORMAL")
+            self._local.conn = conn
+        return conn
+
+    def _ensure_table(self, app_id: int, channel_id: int | None, create: bool) -> str:
+        table = _table_name(app_id, channel_id)
+        if table in self._known_tables:
+            return table
+        conn = self._conn()
+        row = conn.execute(
+            "SELECT name FROM sqlite_master WHERE type='table' AND name=?", (table,)
+        ).fetchone()
+        if row is None:
+            if not create:
+                raise StorageError(
+                    f"events table for app {app_id} channel {channel_id} "
+                    "not initialized (run init_app / `pio app new`)"
+                )
+            with self._lock:
+                conn.executescript(_SCHEMA.format(table=table))
+                conn.commit()
+        else:
+            # resume the tie-break sequence past any rows already on disk
+            (mx,) = conn.execute(f"SELECT COALESCE(MAX(seq), 0) FROM {table}").fetchone()
+            with self._lock:
+                self._seq = max(self._seq, int(mx))
+        self._known_tables.add(table)
+        return table
+
+    # -- lifecycle --------------------------------------------------------
+    def init_app(self, app_id: int, channel_id: int | None = None) -> bool:
+        self._ensure_table(app_id, channel_id, create=True)
+        return True
+
+    def remove_app(self, app_id: int, channel_id: int | None = None) -> bool:
+        table = _table_name(app_id, channel_id)
+        conn = self._conn()
+        with self._lock:
+            conn.execute(f"DROP TABLE IF EXISTS {table}")
+            conn.commit()
+            self._known_tables.discard(table)
+        return True
+
+    def close(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            conn.close()
+            self._local.conn = None
+
+    # -- writes -----------------------------------------------------------
+    def _row(self, e: Event) -> tuple:
+        return (
+            e.event_id,
+            e.event,
+            e.entity_type,
+            e.entity_id,
+            e.target_entity_type,
+            e.target_entity_id,
+            e.properties.to_json(),
+            e.event_time.timestamp(),
+            json.dumps(list(e.tags)),
+            e.pr_id,
+            e.creation_time.timestamp(),
+        )
+
+    def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
+        table = self._ensure_table(app_id, channel_id, create=True)
+        e = event if event.event_id else event.with_id(uuid.uuid4().hex)
+        conn = self._conn()
+        with self._lock:
+            self._seq += 1
+            conn.execute(
+                f"INSERT OR REPLACE INTO {table} VALUES (?,?,?,?,?,?,?,?,?,?,?,?)",
+                self._row(e) + (self._seq,),
+            )
+            conn.commit()
+        return e.event_id  # type: ignore[return-value]
+
+    def insert_batch(
+        self, events: Sequence[Event], app_id: int, channel_id: int | None = None
+    ) -> list[str]:
+        table = self._ensure_table(app_id, channel_id, create=True)
+        withids = [e if e.event_id else e.with_id(uuid.uuid4().hex) for e in events]
+        conn = self._conn()
+        with self._lock:
+            rows = []
+            for e in withids:
+                self._seq += 1
+                rows.append(self._row(e) + (self._seq,))
+            conn.executemany(
+                f"INSERT OR REPLACE INTO {table} VALUES (?,?,?,?,?,?,?,?,?,?,?,?)", rows
+            )
+            conn.commit()
+        return [e.event_id for e in withids]  # type: ignore[misc]
+
+    # -- point ops --------------------------------------------------------
+    def _from_row(self, row: tuple) -> Event:
+        return Event(
+            event_id=row[0],
+            event=row[1],
+            entity_type=row[2],
+            entity_id=row[3],
+            target_entity_type=row[4],
+            target_entity_id=row[5],
+            properties=DataMap.from_json(row[6]),
+            event_time=datetime.fromtimestamp(row[7], tz=timezone.utc),
+            tags=tuple(json.loads(row[8])),
+            pr_id=row[9],
+            creation_time=datetime.fromtimestamp(row[10], tz=timezone.utc),
+        )
+
+    def get(self, event_id: str, app_id: int, channel_id: int | None = None) -> Event | None:
+        table = self._ensure_table(app_id, channel_id, create=False)
+        row = self._conn().execute(
+            f"SELECT * FROM {table} WHERE event_id=?", (event_id,)
+        ).fetchone()
+        return self._from_row(row) if row else None
+
+    def delete(self, event_id: str, app_id: int, channel_id: int | None = None) -> bool:
+        table = self._ensure_table(app_id, channel_id, create=False)
+        conn = self._conn()
+        with self._lock:
+            cur = conn.execute(f"DELETE FROM {table} WHERE event_id=?", (event_id,))
+            conn.commit()
+            return cur.rowcount > 0
+
+    # -- scans ------------------------------------------------------------
+    def find(self, query: EventQuery) -> Iterator[Event]:
+        table = self._ensure_table(query.app_id, query.channel_id, create=False)
+        clauses, params = [], []
+        if query.start_time is not None:
+            clauses.append("event_time >= ?")
+            params.append(query.start_time.timestamp())
+        if query.until_time is not None:
+            clauses.append("event_time < ?")
+            params.append(query.until_time.timestamp())
+        if query.entity_type is not None:
+            clauses.append("entity_type = ?")
+            params.append(query.entity_type)
+        if query.entity_id is not None:
+            clauses.append("entity_id = ?")
+            params.append(query.entity_id)
+        if query.event_names is not None:
+            clauses.append(
+                "event IN (%s)" % ",".join("?" * len(query.event_names))
+            )
+            params.extend(query.event_names)
+        if query.target_entity_type is not ANY:
+            if query.target_entity_type is None:
+                clauses.append("target_entity_type IS NULL")
+            else:
+                clauses.append("target_entity_type = ?")
+                params.append(query.target_entity_type)
+        if query.target_entity_id is not ANY:
+            if query.target_entity_id is None:
+                clauses.append("target_entity_id IS NULL")
+            else:
+                clauses.append("target_entity_id = ?")
+                params.append(query.target_entity_id)
+        where = (" WHERE " + " AND ".join(clauses)) if clauses else ""
+        order = "DESC" if query.reversed else "ASC"
+        sql = f"SELECT * FROM {table}{where} ORDER BY event_time {order}, seq {order}"
+        if query.limit is not None and query.limit >= 0:
+            sql += f" LIMIT {int(query.limit)}"
+        for row in self._conn().execute(sql, params):
+            yield self._from_row(row)

@@ -1,0 +1,46 @@
+"""Test fixtures.
+
+The analog of the reference's ``SharedSparkContext``/``LocalSparkContext``
+(reference: core/src/test/scala/io/prediction/workflow/BaseTest.scala):
+where the reference stands in a `local[4]` Spark for a cluster, we stand in
+an 8-device virtual CPU mesh for a TPU pod slice. Must set XLA_FLAGS before
+jax initializes, hence module-level os.environ mutation here.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+from predictionio_tpu.storage import Storage  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def clean_storage():
+    """Fresh in-memory storage per test (the reference drops HBase
+    namespaces between specs, StorageTestUtils.scala:16-40)."""
+    Storage.reset()
+    Storage.configure("METADATA", "memory")
+    Storage.configure("EVENTDATA", "memory")
+    Storage.configure("MODELDATA", "memory")
+    yield
+    Storage.reset()
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    import jax
+    from predictionio_tpu.parallel.mesh import make_mesh
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return make_mesh((4, 2), ("data", "model"))
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(7)

@@ -1,0 +1,143 @@
+"""$set/$unset/$delete aggregation — mirrors reference LEventAggregatorSpec /
+PEventAggregatorSpec (data/src/test/.../LEventAggregatorSpec.scala), plus
+monoid shard-merge properties the reference exercises via aggregateByKey."""
+
+import random
+from datetime import datetime, timedelta, timezone
+
+from predictionio_tpu.storage import (
+    DataMap,
+    Event,
+    EventOp,
+    aggregate_properties,
+    aggregate_properties_single,
+)
+
+T0 = datetime(2020, 1, 1, tzinfo=timezone.utc)
+
+
+def special(event, eid, props, minutes):
+    return Event(
+        event=event,
+        entity_type="user",
+        entity_id=eid,
+        properties=DataMap(props),
+        event_time=T0 + timedelta(minutes=minutes),
+    )
+
+
+def test_set_merge_latest_wins():
+    events = [
+        special("$set", "u1", {"a": 1, "b": 1}, 0),
+        special("$set", "u1", {"b": 2, "c": 3}, 1),
+    ]
+    out = aggregate_properties(events)
+    assert out["u1"].to_dict() == {"a": 1, "b": 2, "c": 3}
+    assert out["u1"].first_updated == T0
+    assert out["u1"].last_updated == T0 + timedelta(minutes=1)
+
+
+def test_unset_removes_keys():
+    events = [
+        special("$set", "u1", {"a": 1, "b": 1}, 0),
+        special("$unset", "u1", {"b": None}, 1),
+    ]
+    out = aggregate_properties(events)
+    assert out["u1"].to_dict() == {"a": 1}
+
+
+def test_unset_then_later_set_restores():
+    events = [
+        special("$set", "u1", {"a": 1}, 0),
+        special("$unset", "u1", {"a": None}, 1),
+        special("$set", "u1", {"a": 9}, 2),
+    ]
+    out = aggregate_properties(events)
+    assert out["u1"].to_dict() == {"a": 9}
+
+
+def test_delete_drops_entity():
+    events = [
+        special("$set", "u1", {"a": 1}, 0),
+        special("$delete", "u1", {}, 1),
+    ]
+    assert aggregate_properties(events) == {}
+
+
+def test_delete_then_set_recreates():
+    events = [
+        special("$set", "u1", {"a": 1, "b": 2}, 0),
+        special("$delete", "u1", {}, 1),
+        special("$set", "u1", {"c": 3}, 2),
+    ]
+    out = aggregate_properties(events)
+    assert out["u1"].to_dict() == {"c": 3}
+    # first_updated spans pre-delete history (reference keeps min over all)
+    assert out["u1"].first_updated == T0
+
+
+def test_non_special_events_ignored():
+    events = [
+        special("$set", "u1", {"a": 1}, 0),
+        Event(event="view", entity_type="user", entity_id="u1",
+              event_time=T0 + timedelta(minutes=5)),
+    ]
+    out = aggregate_properties(events)
+    assert out["u1"].to_dict() == {"a": 1}
+    assert out["u1"].last_updated == T0  # view didn't update
+
+
+def test_never_set_entity_dropped():
+    events = [special("$unset", "u2", {"x": None}, 0)]
+    assert aggregate_properties(events) == {}
+
+
+def test_single_entity():
+    events = [
+        special("$set", "u1", {"a": 1}, 0),
+        special("$set", "u1", {"b": 2}, 1),
+    ]
+    pm = aggregate_properties_single(iter(events))
+    assert pm is not None and pm.to_dict() == {"a": 1, "b": 2}
+    assert aggregate_properties_single(iter([])) is None
+
+
+def test_monoid_merge_order_independent():
+    """The parallel aggregator relies on EventOp being a commutative monoid
+    (reference PEventAggregator.scala:95-190): any shard split / merge order
+    must give the sequential answer."""
+    rnd = random.Random(3)
+    events = []
+    for m in range(40):
+        kind = rnd.choice(["$set", "$set", "$unset", "$delete"])
+        props = {rnd.choice("abcd"): rnd.randint(0, 9)} if kind == "$set" else (
+            {rnd.choice("abcd"): None} if kind == "$unset" else {})
+        events.append(special(kind, "u1", props, m))
+
+    expected = aggregate_properties(events)
+
+    for _ in range(10):
+        shuffled = events[:]
+        rnd.shuffle(shuffled)
+        # simulate three shards merged pairwise in random order
+        shards = [shuffled[0::3], shuffled[1::3], shuffled[2::3]]
+        ops = []
+        for shard in shards:
+            acc = None
+            for e in shard:
+                op = EventOp.from_event(e)
+                acc = op if acc is None else acc.merge(op)
+            if acc is not None:
+                ops.append(acc)
+        rnd.shuffle(ops)
+        total = ops[0]
+        for op in ops[1:]:
+            total = total.merge(op)
+        got = total.to_property_map()
+        if not expected:
+            assert got is None
+        else:
+            assert got is not None
+            assert got.to_dict() == expected["u1"].to_dict()
+            assert got.first_updated == expected["u1"].first_updated
+            assert got.last_updated == expected["u1"].last_updated

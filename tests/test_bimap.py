@@ -1,0 +1,61 @@
+"""BiMap — mirrors reference BiMapSpec
+(data/src/test/.../storage/BiMapSpec.scala:1-196)."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.storage import BiMap, string_int_bimap
+
+
+def test_forward_and_inverse():
+    bm = BiMap({"a": 1, "b": 2})
+    assert bm["a"] == 1
+    assert bm.inverse[2] == "b"
+    assert bm.inverse.inverse["a"] == 1
+
+
+def test_duplicate_values_rejected():
+    with pytest.raises(ValueError):
+        BiMap({"a": 1, "b": 1})
+
+
+def test_missing_key():
+    bm = BiMap({"a": 1})
+    with pytest.raises(KeyError):
+        bm["zzz"]
+    assert bm.get("zzz") is None
+    assert bm.get_or_else("zzz", -1) == -1
+    assert "a" in bm and "zzz" not in bm
+
+
+def test_string_int():
+    bm = string_int_bimap(["x", "y", "x", "z"])
+    assert len(bm) == 3
+    assert sorted(bm.values()) == [0, 1, 2]
+    # distinct keys map to distinct dense indices
+    assert len(set(bm.values())) == 3
+
+
+def test_from_array_vectorized():
+    keys = np.asarray(["u3", "u1", "u3", "u2", "u1"], dtype=object)
+    bm, idx = BiMap.from_array(keys)
+    assert len(bm) == 3
+    # indices consistent with the map
+    for k, i in zip(keys, idx):
+        assert bm[k] == i
+    assert idx.dtype == np.int32
+
+
+def test_map_array_with_unseen():
+    bm = string_int_bimap(["a", "b"])
+    out = bm.map_array(["a", "nope", "b"])
+    assert out[0] == bm["a"]
+    assert out[1] == -1
+    assert out[2] == bm["b"]
+
+
+def test_inverse_array():
+    bm = string_int_bimap(["a", "b", "c"])
+    arr = bm.inverse_array()
+    for k in ("a", "b", "c"):
+        assert arr[bm[k]] == k

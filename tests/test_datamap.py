@@ -1,0 +1,54 @@
+"""DataMap semantics — mirrors reference DataMapSpec
+(data/src/test/scala/io/prediction/data/storage/DataMapSpec.scala)."""
+
+import pytest
+
+from predictionio_tpu.storage import DataMap, DataMapError
+
+
+def test_get_required_and_optional():
+    dm = DataMap({"a": 1, "b": "x", "c": 2.5, "d": [1, 2], "e": None})
+    assert dm.get("a") == 1
+    assert dm.get("a", float) == 1.0
+    assert dm.get("b", str) == "x"
+    assert dm.get_opt("missing") is None
+    assert dm.get_opt("e") is None  # null counts as absent
+    assert dm.get_or_else("missing", 9) == 9
+    assert dm.get_or_else("a", 9) == 1
+    assert dm.get_string_list("d") == ["1", "2"]
+
+
+def test_get_missing_raises():
+    dm = DataMap({"a": 1})
+    with pytest.raises(DataMapError):
+        dm.get("nope")
+    with pytest.raises(DataMapError):
+        DataMap({"e": None}).get("e")
+
+
+def test_type_mismatch_raises():
+    dm = DataMap({"a": "str"})
+    with pytest.raises(DataMapError):
+        dm.get("a", int)
+
+
+def test_union_and_difference():
+    a = DataMap({"x": 1, "y": 2})
+    b = DataMap({"y": 3, "z": 4})
+    assert (a + b).to_dict() == {"x": 1, "y": 3, "z": 4}
+    assert (a - {"y"}).to_dict() == {"x": 1}
+    # immutability
+    assert a.to_dict() == {"x": 1, "y": 2}
+
+
+def test_json_roundtrip():
+    dm = DataMap({"a": 1, "b": [1, "two"], "c": {"n": None}})
+    assert DataMap.from_json(dm.to_json()) == dm
+
+
+def test_mapping_protocol():
+    dm = DataMap({"a": 1})
+    assert "a" in dm
+    assert len(dm) == 1
+    assert dict(dm) == {"a": 1}
+    assert dm == {"a": 1}

@@ -1,0 +1,103 @@
+"""Event validation rules — mirrors reference EventValidation
+(data/.../storage/Event.scala:70-115) and the API JSON wire format
+(EventJson4sSupport.scala)."""
+
+from datetime import datetime, timezone
+
+import pytest
+
+from predictionio_tpu.storage import (
+    DataMap,
+    Event,
+    ValidationError,
+    event_from_api_dict,
+    event_from_json,
+    event_to_api_dict,
+    validate_event,
+)
+
+
+def ev(**kw):
+    base = dict(event="view", entity_type="user", entity_id="u1")
+    base.update(kw)
+    return Event(**base)
+
+
+def test_valid_plain_event():
+    validate_event(ev())
+
+
+def test_empty_fields_rejected():
+    for kw in ({"event": ""}, {"entity_type": ""}, {"entity_id": ""}):
+        with pytest.raises(ValidationError):
+            validate_event(ev(**kw))
+
+
+def test_target_entity_must_pair():
+    with pytest.raises(ValidationError):
+        validate_event(ev(target_entity_type="item"))
+    with pytest.raises(ValidationError):
+        validate_event(ev(target_entity_id="i1"))
+    validate_event(ev(target_entity_type="item", target_entity_id="i1"))
+
+
+def test_special_events():
+    validate_event(ev(event="$set", properties=DataMap({"a": 1})))
+    validate_event(ev(event="$delete"))
+    # $unset needs non-empty properties
+    with pytest.raises(ValidationError):
+        validate_event(ev(event="$unset"))
+    validate_event(ev(event="$unset", properties=DataMap({"a": None})))
+    # special events cannot have target entity
+    with pytest.raises(ValidationError):
+        validate_event(
+            ev(event="$set", target_entity_type="item", target_entity_id="i1")
+        )
+
+
+def test_reserved_prefixes():
+    with pytest.raises(ValidationError):
+        validate_event(ev(event="$custom"))
+    with pytest.raises(ValidationError):
+        validate_event(ev(event="pio_view"))
+    with pytest.raises(ValidationError):
+        validate_event(ev(entity_type="pio_user"))
+    with pytest.raises(ValidationError):
+        validate_event(ev(properties=DataMap({"pio_x": 1})))
+    # built-in entity type allowed
+    validate_event(ev(entity_type="pio_pr"))
+
+
+def test_api_dict_roundtrip():
+    e = ev(
+        target_entity_type="item",
+        target_entity_id="i1",
+        properties=DataMap({"rating": 4.5}),
+        event_time=datetime(2020, 1, 2, 3, 4, 5, tzinfo=timezone.utc),
+        tags=("t1", "t2"),
+        pr_id="pr1",
+    ).with_id("abc")
+    d = event_to_api_dict(e)
+    assert d["eventTime"] == "2020-01-02T03:04:05Z"
+    e2 = event_from_api_dict(d)
+    assert e2.event == e.event
+    assert e2.entity_id == e.entity_id
+    assert e2.target_entity_id == "i1"
+    assert e2.properties == e.properties
+    assert e2.event_time == e.event_time
+    assert e2.tags == ("t1", "t2")
+    assert e2.pr_id == "pr1"
+
+
+def test_api_dict_missing_fields():
+    with pytest.raises(ValidationError):
+        event_from_api_dict({"event": "view"})
+    with pytest.raises(ValidationError):
+        event_from_api_dict({"event": "view", "entityType": "u", "entityId": 5})
+    with pytest.raises(ValidationError):
+        event_from_json('{"event":"view","entityType":"u","entityId":"1","eventTime":"nope"}')
+
+
+def test_naive_datetime_coerced_to_utc():
+    e = ev(event_time=datetime(2020, 1, 1))
+    assert e.event_time.tzinfo is not None

@@ -1,0 +1,150 @@
+"""Event store backends — mirrors reference LEventsSpec/PEventsSpec
+(data/src/test/.../storage/LEventsSpec.scala:1-218, PEventsSpec.scala:1-190)
+parametrized over backends like the reference parametrizes HBase/ES/JDBC."""
+
+from datetime import datetime, timedelta, timezone
+
+import pytest
+
+from predictionio_tpu.storage import (
+    ANY,
+    DataMap,
+    Event,
+    EventQuery,
+    MemoryEvents,
+    SQLiteEvents,
+)
+
+T0 = datetime(2020, 1, 1, tzinfo=timezone.utc)
+APP = 1
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def backend(request, tmp_path):
+    if request.param == "memory":
+        be = MemoryEvents()
+    else:
+        be = SQLiteEvents({"path": str(tmp_path / "events.db")})
+    be.init_app(APP)
+    yield be
+    be.close()
+
+
+def mk(event="view", eid="u1", target=None, minutes=0, props=None):
+    return Event(
+        event=event,
+        entity_type="user",
+        entity_id=eid,
+        target_entity_type="item" if target else None,
+        target_entity_id=target,
+        properties=DataMap(props or {}),
+        event_time=T0 + timedelta(minutes=minutes),
+    )
+
+
+def test_insert_get_delete(backend):
+    eid = backend.insert(mk(), APP)
+    assert eid
+    e = backend.get(eid, APP)
+    assert e is not None and e.event == "view" and e.event_id == eid
+    assert backend.delete(eid, APP)
+    assert backend.get(eid, APP) is None
+    assert not backend.delete(eid, APP)
+
+
+def test_find_time_range(backend):
+    for m in range(5):
+        backend.insert(mk(minutes=m), APP)
+    got = list(
+        backend.find(
+            EventQuery(
+                app_id=APP,
+                start_time=T0 + timedelta(minutes=1),
+                until_time=T0 + timedelta(minutes=3),
+            )
+        )
+    )
+    assert [e.event_time for e in got] == [
+        T0 + timedelta(minutes=1),
+        T0 + timedelta(minutes=2),
+    ]
+
+
+def test_find_filters(backend):
+    backend.insert(mk(event="view", eid="u1"), APP)
+    backend.insert(mk(event="buy", eid="u1", target="i1", minutes=1), APP)
+    backend.insert(mk(event="buy", eid="u2", target="i2", minutes=2), APP)
+
+    assert len(list(backend.find(EventQuery(APP, entity_id="u1")))) == 2
+    assert len(list(backend.find(EventQuery(APP, event_names=("buy",))))) == 2
+    assert len(list(backend.find(EventQuery(APP, event_names=("buy",), entity_id="u2")))) == 1
+    # target filters: ANY / None / exact (LEvents.scala:111-118 semantics)
+    assert len(list(backend.find(EventQuery(APP, target_entity_id=ANY)))) == 3
+    assert len(list(backend.find(EventQuery(APP, target_entity_id=None)))) == 1
+    assert len(list(backend.find(EventQuery(APP, target_entity_id="i2")))) == 1
+    assert len(list(backend.find(EventQuery(APP, target_entity_type="item")))) == 2
+
+
+def test_find_limit_and_reversed(backend):
+    for m in range(10):
+        backend.insert(mk(minutes=m), APP)
+    got = list(backend.find(EventQuery(APP, limit=3)))
+    assert len(got) == 3
+    assert got[0].event_time == T0
+    rev = list(backend.find(EventQuery(APP, limit=2, reversed=True)))
+    assert rev[0].event_time == T0 + timedelta(minutes=9)
+    # limit=-1 means all (LEvents.scala:119)
+    assert len(list(backend.find(EventQuery(APP, limit=-1)))) == 10
+
+
+def test_channels_isolated(backend):
+    backend.init_app(APP, 7)
+    backend.insert(mk(), APP)
+    backend.insert(mk(eid="u9"), APP, 7)
+    assert len(list(backend.find(EventQuery(APP)))) == 1
+    got = list(backend.find(EventQuery(APP, channel_id=7)))
+    assert len(got) == 1 and got[0].entity_id == "u9"
+
+
+def test_remove_app(backend):
+    backend.insert(mk(), APP)
+    assert backend.remove_app(APP)
+    backend.init_app(APP)
+    assert list(backend.find(EventQuery(APP))) == []
+
+
+def test_aggregate_properties(backend):
+    backend.insert(mk(event="$set", eid="u1", props={"a": 1, "b": 2}), APP)
+    backend.insert(mk(event="$set", eid="u1", props={"b": 3}, minutes=1), APP)
+    backend.insert(mk(event="$set", eid="u2", props={"a": 9}), APP)
+    backend.insert(mk(event="$delete", eid="u2", minutes=1), APP)
+    out = backend.aggregate_properties(APP, entity_type="user")
+    assert set(out) == {"u1"}
+    assert out["u1"].to_dict() == {"a": 1, "b": 3}
+    # required-field filter (PEvents.scala:95-103)
+    out2 = backend.aggregate_properties(APP, entity_type="user", required=["missing"])
+    assert out2 == {}
+
+
+def test_aggregate_single_entity(backend):
+    backend.insert(mk(event="$set", eid="u1", props={"a": 1}), APP)
+    pm = backend.aggregate_properties_of_entity(APP, "user", "u1")
+    assert pm is not None and pm.to_dict() == {"a": 1}
+    assert backend.aggregate_properties_of_entity(APP, "user", "nope") is None
+
+
+def test_insert_batch(backend):
+    ids = backend.insert_batch([mk(minutes=m) for m in range(4)], APP)
+    assert len(ids) == len(set(ids)) == 4
+    assert len(list(backend.find(EventQuery(APP)))) == 4
+
+
+def test_find_frame_columnar(backend):
+    backend.insert(mk(event="rate", eid="u1", target="i1", props={"rating": 4.0}), APP)
+    backend.insert(mk(event="rate", eid="u2", target="i2", props={"rating": 2.0}, minutes=1), APP)
+    frame = backend.find_frame(EventQuery(APP, event_names=("rate",)))
+    assert len(frame) == 2
+    assert list(frame.entity_id) == ["u1", "u2"]
+    ratings = frame.to_ratings()
+    assert len(ratings) == 2
+    assert ratings.num_users == 2 and ratings.num_items == 2

@@ -1,0 +1,115 @@
+"""Metadata DAOs — covers the CRUD surface of the reference's
+Apps/AccessKeys/Channels/EngineManifests/EngineInstances/EvaluationInstances/
+Models traits (data/src/main/.../storage/*.scala)."""
+
+from datetime import datetime, timedelta, timezone
+
+from predictionio_tpu.storage import (
+    EngineInstance,
+    EngineManifest,
+    EvaluationInstance,
+    MetadataStore,
+    Model,
+)
+
+
+def test_apps_crud():
+    s = MetadataStore()
+    app = s.app_insert("myapp", "desc")
+    assert app is not None and app.id > 0
+    assert s.app_insert("myapp") is None  # duplicate name
+    assert s.app_get(app.id) == app
+    assert s.app_get_by_name("myapp") == app
+    assert s.app_get_by_name("nope") is None
+    app2 = s.app_insert("other")
+    assert {a.name for a in s.app_get_all()} == {"myapp", "other"}
+    assert s.app_delete(app2.id)
+    assert s.app_get(app2.id) is None
+
+
+def test_access_keys():
+    s = MetadataStore()
+    app = s.app_insert("a")
+    ak = s.access_key_insert(app.id, events=("view",))
+    assert len(ak.key) > 20
+    assert s.access_key_get(ak.key) == ak
+    ak2 = s.access_key_insert(app.id)
+    assert ak2.events == ()
+    assert len(s.access_key_get_by_appid(app.id)) == 2
+    assert s.access_key_delete(ak.key)
+    assert s.access_key_get(ak.key) is None
+
+
+def test_channels():
+    s = MetadataStore()
+    app = s.app_insert("a")
+    ch = s.channel_insert(app.id, "mobile")
+    assert ch is not None
+    assert s.channel_insert(app.id, "bad name!") is None  # regex
+    assert s.channel_insert(app.id, "x" * 17) is None  # too long
+    assert s.channel_insert(app.id, "mobile") is None  # duplicate
+    assert s.channel_get(ch.id) == ch
+    assert [c.name for c in s.channel_get_by_appid(app.id)] == ["mobile"]
+    assert s.channel_delete(ch.id)
+
+
+def test_engine_manifests():
+    s = MetadataStore()
+    m = EngineManifest(id="e1", version="1", name="my-engine", engine_factory="pkg.Factory")
+    s.engine_manifest_insert(m)
+    assert s.engine_manifest_get("e1", "1") == m
+    assert s.engine_manifest_get("e1", "2") is None
+    assert len(s.engine_manifest_get_all()) == 1
+    assert s.engine_manifest_delete("e1", "1")
+
+
+def test_engine_instances_lifecycle():
+    s = MetadataStore()
+    t = datetime.now(timezone.utc)
+    i1 = EngineInstance(
+        status="INIT", engine_id="e1", engine_version="1",
+        engine_variant="default", start_time=t - timedelta(hours=2),
+    )
+    id1 = s.engine_instance_insert(i1)
+    assert id1
+    got = s.engine_instance_get(id1)
+    assert got.status == "INIT"
+    s.engine_instance_update(
+        EngineInstance(**{**got.__dict__, "status": "COMPLETED"})
+    )
+    i2 = EngineInstance(
+        status="COMPLETED", engine_id="e1", engine_version="1",
+        engine_variant="default", start_time=t,
+    )
+    s.engine_instance_insert(i2)
+    latest = s.engine_instance_get_latest_completed("e1", "1", "default")
+    assert latest is not None and latest.start_time == t
+    assert len(s.engine_instance_get_completed("e1", "1", "default")) == 2
+    assert s.engine_instance_get_latest_completed("e1", "1", "other") is None
+
+
+def test_evaluation_instances():
+    s = MetadataStore()
+    eid = s.evaluation_instance_insert(EvaluationInstance(status="INIT"))
+    got = s.evaluation_instance_get(eid)
+    assert got.status == "INIT"
+    s.evaluation_instance_update(
+        EvaluationInstance(**{**got.__dict__, "status": "EVALCOMPLETED"})
+    )
+    assert len(s.evaluation_instance_get_completed()) == 1
+
+
+def test_models():
+    s = MetadataStore()
+    s.model_insert(Model(id="i1", models=b"\x00\x01binary"))
+    m = s.model_get("i1")
+    assert m is not None and m.models == b"\x00\x01binary"
+    assert s.model_delete("i1")
+    assert s.model_get("i1") is None
+
+
+def test_sequences():
+    s = MetadataStore()
+    assert s.next_id("x") == 1
+    assert s.next_id("x") == 2
+    assert s.next_id("y") == 1
