@@ -1,0 +1,6 @@
+"""REST API plane: event server, stats, webhooks (L3 of the framework)."""
+
+from .event_server import AuthData, create_event_app, run_event_server
+from .stats import Stats
+
+__all__ = ["AuthData", "Stats", "create_event_app", "run_event_server"]

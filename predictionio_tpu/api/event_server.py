@@ -1,0 +1,301 @@
+"""The event server: REST ingestion API on :7070.
+
+Analog of the reference's spray/akka ``EventServiceActor``/``EventServer``
+(reference: data/src/main/scala/io/prediction/data/api/EventAPI.scala:60-479)
+re-built on asyncio/aiohttp. Route surface kept wire-compatible:
+
+- ``GET  /``                     -> {"status": "alive"}
+- ``POST /events.json``          -> 201 {"eventId": ...}
+- ``POST /batch/events.json``    -> per-event status list (batch ingest)
+- ``GET  /events.json``          -> filtered scan (default limit 20)
+- ``GET  /events/<id>.json``     -> one event
+- ``DELETE /events/<id>.json``   -> {"message": "Found"} | 404
+- ``GET  /stats.json``           -> ingestion counters (with --stats)
+- ``POST /webhooks/<name>.json`` -> JSON connector ingestion
+- ``POST /webhooks/<name>``      -> form connector ingestion
+- ``GET  /webhooks/<name>[.json]`` -> connector presence check
+
+Auth: ``?accessKey=`` resolved against the metadata store; optional
+``?channel=`` resolved per app (EventAPI.scala:88-116). Event writes run
+in a thread pool so slow storage never blocks the accept loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from dataclasses import dataclass
+from datetime import datetime
+
+from aiohttp import web
+
+from ..storage import (
+    EventQuery,
+    Storage,
+    ValidationError,
+    event_from_api_dict,
+    event_to_api_dict,
+)
+from ..storage.event import _dt_from_wire
+from ..storage.events_base import StorageError
+from .stats import Stats
+from .webhooks import ConnectorException, FormConnector, JsonConnector, get_connector
+
+log = logging.getLogger("predictionio_tpu.eventserver")
+
+__all__ = ["create_event_app", "run_event_server", "AuthData"]
+
+STATS_KEY = web.AppKey("stats", object)
+
+
+@dataclass
+class AuthData:
+    app_id: int
+    channel_id: int | None
+
+
+def _json_error(status: int, message: str) -> web.Response:
+    return web.json_response({"message": message}, status=status)
+
+
+async def _authenticate(request: web.Request) -> AuthData | web.Response:
+    """Query-param access-key auth (EventAPI.scala:88-116)."""
+    access_key = request.query.get("accessKey")
+    if not access_key:
+        return _json_error(401, "Missing accessKey.")
+    meta = Storage.get_metadata()
+    ak = await asyncio.to_thread(meta.access_key_get, access_key)
+    if ak is None:
+        return _json_error(401, "Invalid accessKey.")
+    channel = request.query.get("channel")
+    if channel is None:
+        return AuthData(app_id=ak.appid, channel_id=None)
+    channels = await asyncio.to_thread(meta.channel_get_by_appid, ak.appid)
+    for ch in channels:
+        if ch.name == channel:
+            return AuthData(app_id=ak.appid, channel_id=ch.id)
+    return _json_error(401, f"Invalid channel '{channel}'.")
+
+
+def _parse_time(s: str | None) -> datetime | None:
+    return None if s is None else _dt_from_wire(s)
+
+
+async def _insert_event_dict(
+    request: web.Request, auth: AuthData, data: dict
+) -> tuple[int, dict]:
+    """Validate + insert one API-JSON event; returns (status, body)."""
+    try:
+        event = event_from_api_dict(data)
+    except ValidationError as e:
+        return 400, {"message": str(e)}
+    events = Storage.get_events()
+    try:
+        event_id = await asyncio.to_thread(
+            events.insert, event, auth.app_id, auth.channel_id
+        )
+    except StorageError as e:
+        return 500, {"message": str(e)}
+    stats: Stats | None = request.app.get(STATS_KEY)
+    if stats is not None:
+        stats.update(
+            auth.app_id, 201,
+            entity_type=event.entity_type,
+            target_entity_type=event.target_entity_type,
+            event=event.event,
+        )
+    return 201, {"eventId": event_id}
+
+
+# -- handlers ---------------------------------------------------------------
+
+async def handle_root(request: web.Request) -> web.Response:
+    return web.json_response({"status": "alive"})
+
+
+async def handle_post_event(request: web.Request) -> web.Response:
+    auth = await _authenticate(request)
+    if isinstance(auth, web.Response):
+        return auth
+    try:
+        data = await request.json()
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        return _json_error(400, "Malformed JSON body.")
+    if not isinstance(data, dict):
+        return _json_error(400, "Event must be a JSON object.")
+    status, body = await _insert_event_dict(request, auth, data)
+    return web.json_response(body, status=status)
+
+
+async def handle_post_batch(request: web.Request) -> web.Response:
+    """Batch ingestion: a JSON array of events; per-event status in order.
+    (The reference gained /batch/events.json right after 0.9.2; the import
+    tool also needs it.) Max 50 per request, like the official SDKs."""
+    auth = await _authenticate(request)
+    if isinstance(auth, web.Response):
+        return auth
+    try:
+        data = await request.json()
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        return _json_error(400, "Malformed JSON body.")
+    if not isinstance(data, list):
+        return _json_error(400, "Batch body must be a JSON array of events.")
+    if len(data) > 50:
+        return _json_error(400, "Batch size exceeds the limit of 50 events.")
+    results = []
+    for item in data:
+        if not isinstance(item, dict):
+            results.append({"status": 400, "message": "Event must be a JSON object."})
+            continue
+        status, body = await _insert_event_dict(request, auth, item)
+        results.append({"status": status, **body})
+    return web.json_response(results, status=200)
+
+
+async def handle_get_events(request: web.Request) -> web.Response:
+    auth = await _authenticate(request)
+    if isinstance(auth, web.Response):
+        return auth
+    q = request.query
+    try:
+        start_time = _parse_time(q.get("startTime"))
+        until_time = _parse_time(q.get("untilTime"))
+    except ValueError as e:
+        return _json_error(400, f"Invalid time: {e}")
+    try:
+        limit = int(q.get("limit", 20))
+        reversed_ = q.get("reversed", "false").lower() == "true"
+    except ValueError as e:
+        return _json_error(400, str(e))
+    event_name = q.get("event")
+    query = EventQuery(
+        app_id=auth.app_id,
+        channel_id=auth.channel_id,
+        start_time=start_time,
+        until_time=until_time,
+        entity_type=q.get("entityType"),
+        entity_id=q.get("entityId"),
+        event_names=(event_name,) if event_name else None,
+        target_entity_type=q.get("targetEntityType", EventQuery.target_entity_type),
+        target_entity_id=q.get("targetEntityId", EventQuery.target_entity_id),
+        limit=limit,
+        reversed=reversed_,
+    )
+    events = Storage.get_events()
+    try:
+        found = await asyncio.to_thread(lambda: list(events.find(query)))
+    except StorageError as e:
+        return _json_error(404, str(e))
+    if not found:
+        # reference returns 404 on empty result (EventAPI.scala:255-260)
+        return _json_error(404, "Not Found")
+    return web.json_response([event_to_api_dict(e) for e in found])
+
+
+async def handle_get_event(request: web.Request) -> web.Response:
+    auth = await _authenticate(request)
+    if isinstance(auth, web.Response):
+        return auth
+    event_id = request.match_info["event_id"]
+    events = Storage.get_events()
+    try:
+        e = await asyncio.to_thread(events.get, event_id, auth.app_id, auth.channel_id)
+    except StorageError as err:
+        return _json_error(404, str(err))
+    if e is None:
+        return _json_error(404, "Not Found")
+    return web.json_response(event_to_api_dict(e))
+
+
+async def handle_delete_event(request: web.Request) -> web.Response:
+    auth = await _authenticate(request)
+    if isinstance(auth, web.Response):
+        return auth
+    event_id = request.match_info["event_id"]
+    events = Storage.get_events()
+    try:
+        found = await asyncio.to_thread(
+            events.delete, event_id, auth.app_id, auth.channel_id
+        )
+    except StorageError as err:
+        return _json_error(404, str(err))
+    if found:
+        return web.json_response({"message": "Found"})
+    return _json_error(404, "Not Found")
+
+
+async def handle_stats(request: web.Request) -> web.Response:
+    auth = await _authenticate(request)
+    if isinstance(auth, web.Response):
+        return auth
+    stats: Stats | None = request.app.get(STATS_KEY)
+    if stats is None:
+        return _json_error(
+            404, "To see stats, launch Event Server with --stats argument."
+        )
+    return web.json_response(stats.get(auth.app_id))
+
+
+async def handle_webhook_post(request: web.Request) -> web.Response:
+    """JSON (.json suffix) and form connectors (Webhooks.scala:36-120)."""
+    auth = await _authenticate(request)
+    if isinstance(auth, web.Response):
+        return auth
+    name = request.match_info["name"]
+    is_json = name.endswith(".json")
+    connector = get_connector(name[:-5] if is_json else name)
+    expected = JsonConnector if is_json else FormConnector
+    if not isinstance(connector, expected):
+        return _json_error(404, f"webhooks connection for {name} is not supported.")
+    try:
+        if is_json:
+            payload = await request.json()
+            if not isinstance(payload, dict):
+                return _json_error(400, "Webhook body must be a JSON object.")
+        else:
+            form = await request.post()
+            payload = {k: form[k] for k in form}
+        event_json = connector.to_event_json(payload)
+    except ConnectorException as e:
+        return _json_error(400, str(e))
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        return _json_error(400, "Malformed body.")
+    status, body = await _insert_event_dict(request, auth, event_json)
+    return web.json_response(body, status=status)
+
+
+async def handle_webhook_get(request: web.Request) -> web.Response:
+    auth = await _authenticate(request)
+    if isinstance(auth, web.Response):
+        return auth
+    name = request.match_info["name"]
+    is_json = name.endswith(".json")
+    connector = get_connector(name[:-5] if is_json else name)
+    expected = JsonConnector if is_json else FormConnector
+    if isinstance(connector, expected):
+        return web.json_response({"message": "Ok"})
+    return _json_error(404, f"webhooks connection for {name} is not supported.")
+
+
+def create_event_app(stats: bool = False) -> web.Application:
+    app = web.Application()
+    app[STATS_KEY] = Stats() if stats else None
+    app.router.add_get("/", handle_root)
+    app.router.add_post("/events.json", handle_post_event)
+    app.router.add_post("/batch/events.json", handle_post_batch)
+    app.router.add_get("/events.json", handle_get_events)
+    app.router.add_get("/events/{event_id}.json", handle_get_event)
+    app.router.add_delete("/events/{event_id}.json", handle_delete_event)
+    app.router.add_get("/stats.json", handle_stats)
+    app.router.add_post("/webhooks/{name}", handle_webhook_post)
+    app.router.add_get("/webhooks/{name}", handle_webhook_get)
+    return app
+
+
+def run_event_server(ip: str = "0.0.0.0", port: int = 7070, stats: bool = False) -> None:
+    """Blocking entry (reference: EventServer.createEventServer,
+    EventAPI.scala:449-468; default port 7070)."""
+    logging.basicConfig(level=logging.INFO)
+    log.info("Event server starting on %s:%d", ip, port)
+    web.run_app(create_event_app(stats=stats), host=ip, port=port, print=None)

@@ -1,0 +1,96 @@
+"""Event-server ingestion statistics.
+
+Analog of the reference's ``Stats``/``StatsActor`` (reference: data/src/main/
+scala/io/prediction/data/api/Stats.scala:27-93, StatsActor.scala:28-70):
+per-app counters keyed by (entityType, event) x HTTP status, bucketed by
+hour. The reference confines mutation to an actor; here a lock suffices
+(counters are tiny and the server is asyncio single-threaded anyway).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import Counter
+from dataclasses import dataclass
+from datetime import datetime, timezone
+
+__all__ = ["Stats", "EntityTypesEvent", "KV"]
+
+
+@dataclass(frozen=True)
+class EntityTypesEvent:
+    """(Stats.scala:27-44)"""
+    entity_type: str
+    target_entity_type: str | None
+    event: str
+
+
+@dataclass(frozen=True)
+class KV:
+    k: EntityTypesEvent
+    v: int
+
+    def to_dict(self) -> dict:
+        return {
+            "entityType": self.k.entity_type,
+            "targetEntityType": self.k.target_entity_type,
+            "event": self.k.event,
+            "count": self.v,
+        }
+
+
+def _hour_bucket(t: datetime) -> datetime:
+    return t.replace(minute=0, second=0, microsecond=0)
+
+
+class Stats:
+    """Hourly (appId, statusCode, ETE) counters. ``get`` reports the
+    previous and current hour buckets (Stats.scala:51-93 keeps a rolling
+    pair the same way)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # bucket-hour -> Counter[(app_id, status, ETE)]
+        self._buckets: dict[datetime, Counter] = {}
+
+    def update(self, app_id: int, status: int, *, entity_type: str,
+               target_entity_type: str | None, event: str,
+               now: datetime | None = None) -> None:
+        now = now or datetime.now(timezone.utc)
+        ete = EntityTypesEvent(entity_type, target_entity_type, event)
+        bucket = _hour_bucket(now)
+        with self._lock:
+            c = self._buckets.setdefault(bucket, Counter())
+            c[(app_id, status, ete)] += 1
+            # retain only the two most recent hour buckets
+            if len(self._buckets) > 2:
+                for old in sorted(self._buckets)[:-2]:
+                    del self._buckets[old]
+
+    def get(self, app_id: int, now: datetime | None = None) -> dict:
+        """JSON-ready snapshot: {"startTime":..., "statusCount": {code: n},
+        "eteCount": [KV...]} for the current+previous hour."""
+        now = now or datetime.now(timezone.utc)
+        current = _hour_bucket(now)
+        with self._lock:
+            status_count: Counter = Counter()
+            ete_count: Counter = Counter()
+            start = None
+            for bucket, c in self._buckets.items():
+                if (current - bucket).total_seconds() > 7200:
+                    continue
+                start = bucket if start is None else min(start, bucket)
+                for (aid, status, ete), n in c.items():
+                    if aid != app_id:
+                        continue
+                    status_count[status] += n
+                    ete_count[ete] += n
+        return {
+            "startTime": start.isoformat() if start else None,
+            "statusCount": {str(k): v for k, v in sorted(status_count.items())},
+            "eteCount": [
+                KV(k, v).to_dict() for k, v in sorted(
+                    ete_count.items(), key=lambda kv: (kv[0].entity_type, kv[0].event)
+                )
+            ],
+        }

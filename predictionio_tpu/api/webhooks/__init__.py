@@ -1,0 +1,22 @@
+"""Webhook connectors (reference: data/.../webhooks/ + api/WebhooksConnectors.scala)."""
+
+from .base import (
+    ConnectorException,
+    FormConnector,
+    JsonConnector,
+    WEBHOOK_CONNECTORS,
+    get_connector,
+    register_connector,
+)
+from .mailchimp import MailChimpConnector
+from .segmentio import SegmentIOConnector
+
+# shipped connectors (reference: api/WebhooksConnectors.scala:25-35)
+register_connector("segmentio", SegmentIOConnector())
+register_connector("mailchimp", MailChimpConnector())
+
+__all__ = [
+    "ConnectorException", "FormConnector", "JsonConnector",
+    "MailChimpConnector", "SegmentIOConnector", "WEBHOOK_CONNECTORS",
+    "get_connector", "register_connector",
+]

@@ -1,0 +1,52 @@
+"""Webhook connector framework.
+
+Third-party services POST their own payload shapes; a connector translates
+them into the universal event JSON (reference: data/src/main/scala/io/
+prediction/data/webhooks/{JsonConnector,FormConnector}.scala and
+api/Webhooks.scala:1-151). Two protocols:
+
+- ``JsonConnector.to_event_json(dict) -> dict``  (JSON body webhooks)
+- ``FormConnector.to_event_json(dict[str,str]) -> dict``  (form-encoded)
+
+Connectors are registered by name in ``WEBHOOK_CONNECTORS`` — the dispatch
+table the event server consults for ``POST /webhooks/<name>.json`` and
+``POST /webhooks/<name>`` (reference: api/WebhooksConnectors.scala).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Mapping
+
+__all__ = [
+    "ConnectorException", "JsonConnector", "FormConnector",
+    "WEBHOOK_CONNECTORS", "register_connector", "get_connector",
+]
+
+
+class ConnectorException(ValueError):
+    """Payload cannot be translated to an event (reference:
+    webhooks/ConnectorException.scala)."""
+
+
+class JsonConnector(abc.ABC):
+    @abc.abstractmethod
+    def to_event_json(self, data: Mapping[str, Any]) -> dict[str, Any]:
+        """Translate a third-party JSON object into event-API JSON."""
+
+
+class FormConnector(abc.ABC):
+    @abc.abstractmethod
+    def to_event_json(self, data: Mapping[str, str]) -> dict[str, Any]:
+        """Translate form fields into event-API JSON."""
+
+
+WEBHOOK_CONNECTORS: dict[str, JsonConnector | FormConnector] = {}
+
+
+def register_connector(name: str, connector: JsonConnector | FormConnector) -> None:
+    WEBHOOK_CONNECTORS[name] = connector
+
+
+def get_connector(name: str) -> JsonConnector | FormConnector | None:
+    return WEBHOOK_CONNECTORS.get(name)

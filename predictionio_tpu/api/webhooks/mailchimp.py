@@ -1,0 +1,59 @@
+"""MailChimp webhook connector (form-encoded payloads).
+
+Reference: data/src/main/scala/io/prediction/data/webhooks/mailchimp/
+MailChimpConnector.scala:30-100 — supports the ``subscribe`` type, parsing
+MailChimp's "yyyy-MM-dd HH:mm:ss" timestamps as UTC.
+"""
+
+from __future__ import annotations
+
+from datetime import datetime, timezone
+from typing import Any, Mapping
+
+from .base import ConnectorException, FormConnector
+
+__all__ = ["MailChimpConnector"]
+
+
+def _parse_mailchimp_time(s: str) -> str:
+    try:
+        t = datetime.strptime(s, "%Y-%m-%d %H:%M:%S").replace(tzinfo=timezone.utc)
+    except ValueError as e:
+        raise ConnectorException(f"Cannot parse MailChimp time {s!r}: {e}") from e
+    return t.isoformat().replace("+00:00", "Z")
+
+
+class MailChimpConnector(FormConnector):
+    def to_event_json(self, data: Mapping[str, str]) -> dict[str, Any]:
+        typ = data.get("type")
+        if typ is None:
+            raise ConnectorException("The field 'type' is required for MailChimp data.")
+        if typ != "subscribe":
+            raise ConnectorException(
+                f"Cannot convert unknown MailChimp data type {typ} to event JSON"
+            )
+        try:
+            return {
+                "event": "subscribe",
+                "entityType": "user",
+                "entityId": data["data[id]"],
+                "targetEntityType": "list",
+                "targetEntityId": data["data[list_id]"],
+                "eventTime": _parse_mailchimp_time(data["fired_at"]),
+                "properties": {
+                    "email": data["data[email]"],
+                    "email_type": data["data[email_type]"],
+                    "merges": {
+                        "EMAIL": data["data[merges][EMAIL]"],
+                        "FNAME": data["data[merges][FNAME]"],
+                        "LNAME": data["data[merges][LNAME]"],
+                        "INTERESTS": data.get("data[merges][INTERESTS]"),
+                    },
+                    "ip_opt": data["data[ip_opt]"],
+                    "ip_signup": data["data[ip_signup]"],
+                },
+            }
+        except KeyError as e:
+            raise ConnectorException(
+                f"The field {e.args[0]} is required for MailChimp subscribe data."
+            ) from e

@@ -1,0 +1,40 @@
+"""Segment.io webhook connector.
+
+Translates Segment's JSON payloads to event JSON (reference: data/src/main/
+scala/io/prediction/data/webhooks/segmentio/SegmentIOConnector.scala:25-70).
+The reference supports the ``identify`` call type; same scope here.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+from .base import ConnectorException, JsonConnector
+
+__all__ = ["SegmentIOConnector"]
+
+
+class SegmentIOConnector(JsonConnector):
+    def to_event_json(self, data: Mapping[str, Any]) -> dict[str, Any]:
+        typ = data.get("type")
+        if typ is None or "timestamp" not in data:
+            raise ConnectorException(
+                f"Cannot extract Common fields (type, timestamp) from {dict(data)}."
+            )
+        if typ != "identify":
+            raise ConnectorException(
+                f"Cannot convert unknown type {typ} to event JSON."
+            )
+        user_id = data.get("userId")
+        if not user_id:
+            raise ConnectorException("The field 'userId' is required for identify.")
+        return {
+            "event": typ,
+            "entityType": "user",
+            "entityId": user_id,
+            "eventTime": data["timestamp"],
+            "properties": {
+                "context": data.get("context"),
+                "traits": data.get("traits"),
+            },
+        }

@@ -76,11 +76,15 @@ class EventOp:
 
     def merge(self, other: "EventOp") -> "EventOp":
         out = EventOp()
-        # $set: per-key last-write-wins
+        # $set: per-key last-write-wins; ties broken deterministically on the
+        # serialized value so merge stays commutative even at equal timestamps
+        # (bulk imports often stamp a whole batch with one eventTime)
         out.set_fields = dict(self.set_fields)
         for k, pt in other.set_fields.items():
             cur = out.set_fields.get(k)
-            if cur is None or pt.t > cur.t:
+            if cur is None or pt.t > cur.t or (
+                pt.t == cur.t and _value_key(pt.value) > _value_key(cur.value)
+            ):
                 out.set_fields[k] = pt
         out.set_t = _max_opt(self.set_t, other.set_t)
         # $unset: latest unset time per key
@@ -110,6 +114,12 @@ class EventOp:
             fields[k] = pt.value
         assert self.first_updated is not None and self.last_updated is not None
         return PropertyMap(fields, self.first_updated, self.last_updated)
+
+
+def _value_key(v: Any) -> str:
+    import json
+
+    return json.dumps(v, sort_keys=True, default=str)
 
 
 def _max_opt(a: float | None, b: float | None) -> float | None:

@@ -33,13 +33,19 @@ def _check_json(value: Any) -> Any:
     return value
 
 
-class DataMap(Mapping[str, Any]):
-    """An immutable mapping of field name -> JSON value.
+class DataMap:
+    """An immutable map of field name -> JSON value.
 
     Mirrors the accessor surface of the reference DataMap: ``get`` (required,
     raises on absence), ``get_opt`` (optional), ``get_or_else``, set-algebra
     ``union``/``difference`` (the reference's ``++``/``--``,
     DataMap.scala:134-145), and typed extraction.
+
+    Deliberately NOT a ``collections.abc.Mapping``: ``get(name, cls)`` here
+    is the reference's typed required accessor (raises on absence), which
+    contradicts ``Mapping.get(key, default)`` — registering as a Mapping
+    would hand that trap to any generic code. Iteration/len/`in`/`==dict`
+    still work structurally.
     """
 
     __slots__ = ("_fields",)
@@ -47,7 +53,7 @@ class DataMap(Mapping[str, Any]):
     def __init__(self, fields: Mapping[str, Any] | None = None):
         self._fields: dict[str, Any] = dict(fields) if fields else {}
 
-    # -- Mapping protocol -------------------------------------------------
+    # -- structural mapping protocol --------------------------------------
     def __getitem__(self, key: str) -> Any:
         return self._fields[key]
 
@@ -73,6 +79,15 @@ class DataMap(Mapping[str, Any]):
     def __repr__(self) -> str:
         return f"DataMap({self._fields!r})"
 
+    def keys(self):
+        return self._fields.keys()
+
+    def values(self):
+        return self._fields.values()
+
+    def items(self):
+        return self._fields.items()
+
     # -- accessors --------------------------------------------------------
     @property
     def fields(self) -> dict[str, Any]:
@@ -85,12 +100,17 @@ class DataMap(Mapping[str, Any]):
     def contains(self, name: str) -> bool:
         return name in self._fields
 
-    def get(self, name: str, cls: type | None = None) -> Any:  # type: ignore[override]
+    def get(self, name: str, cls: type | None = None) -> Any:
         """Required typed accessor. Raises ``DataMapError`` if absent or null.
 
         If ``cls`` is given, the value is coerced/validated to that type
         (int/float interconversion allowed, as JSON does not distinguish).
         """
+        if cls is not None and not isinstance(cls, type):
+            raise TypeError(
+                "DataMap.get(name, cls) takes a type, not a default value; "
+                "use get_or_else(name, default)"
+            )
         self.require(name)
         value = self._fields[name]
         if value is None:

@@ -19,6 +19,8 @@ import threading
 from dataclasses import asdict, dataclass, field, replace
 from datetime import datetime, timezone
 
+from ._sqlite_util import LockedConnection
+
 __all__ = [
     "App", "AccessKey", "Channel", "EngineManifest", "EngineInstance",
     "EvaluationInstance", "Model", "MetadataStore", "CHANNEL_NAME_RE",
@@ -156,12 +158,21 @@ class MetadataStore:
     """
 
     def __init__(self, path: str = ":memory:"):
+        # A plain :memory: database is private to one connection, so in-memory
+        # mode shares a single serialized connection across threads (sqlite3
+        # is built in serialized threading mode; our writes additionally hold
+        # self._lock so transactions never interleave). File mode uses
+        # per-thread connections + WAL.
+        self._memory = path == ":memory:"
         self._path = path
         self._local = threading.local()
         self._lock = threading.RLock()
+        self._shared = LockedConnection(path, self._lock) if self._memory else None
         self._init_schema()
 
     def _conn(self) -> sqlite3.Connection:
+        if self._shared is not None:
+            return self._shared
         conn = getattr(self._local, "conn", None)
         if conn is None:
             conn = sqlite3.connect(self._path, timeout=30.0)
@@ -201,6 +212,9 @@ class MetadataStore:
         if conn is not None:
             conn.close()
             self._local.conn = None
+        if self._shared is not None:
+            self._shared.close()
+            self._shared = None
 
     # -- sequences (ESSequences analog) -----------------------------------
     def next_id(self, name: str) -> int:
@@ -244,9 +258,13 @@ class MetadataStore:
     def app_update(self, app: App) -> bool:
         c = self._conn()
         with self._lock:
-            cur = c.execute(
-                "UPDATE apps SET name=?, doc=? WHERE id=?", (app.name, _ser(app), app.id)
-            )
+            try:
+                cur = c.execute(
+                    "UPDATE apps SET name=?, doc=? WHERE id=?",
+                    (app.name, _ser(app), app.id),
+                )
+            except sqlite3.IntegrityError:  # rename onto an existing name
+                return False
             c.commit()
             return cur.rowcount > 0
 

@@ -23,6 +23,7 @@ import uuid
 from datetime import datetime, timezone
 from typing import Iterator, Sequence
 
+from ._sqlite_util import LockedConnection
 from .datamap import DataMap
 from .event import Event
 from .events_base import ANY, EventBackend, EventQuery, StorageError
@@ -58,13 +59,19 @@ def _table_name(app_id: int, channel_id: int | None) -> str:
 class SQLiteEvents(EventBackend):
     def __init__(self, config: dict | None = None):
         config = config or {}
-        self._path = config.get("path", ":memory:")
+        path = config.get("path", ":memory:")
+        # see MetadataStore._conn: in-memory mode = one serialized connection
+        self._memory = path == ":memory:"
+        self._path = path
         self._local = threading.local()
         self._lock = threading.RLock()
+        self._shared = LockedConnection(path, self._lock) if self._memory else None
         self._known_tables: set[str] = set()
         self._seq = 0
 
     def _conn(self) -> sqlite3.Connection:
+        if self._shared is not None:
+            return self._shared
         conn = getattr(self._local, "conn", None)
         if conn is None:
             conn = sqlite3.connect(self._path, timeout=30.0)
@@ -117,6 +124,9 @@ class SQLiteEvents(EventBackend):
         if conn is not None:
             conn.close()
             self._local.conn = None
+        if self._shared is not None:
+            self._shared.close()
+            self._shared = None
 
     # -- writes -----------------------------------------------------------
     def _row(self, e: Event) -> tuple:

@@ -1,0 +1,254 @@
+"""Event server REST surface — mirrors reference EventServiceSpec
+(data/src/test/.../api/EventServiceSpec.scala:24-77) extended to the full
+route table, driven over real HTTP like the SDKs would."""
+
+import threading
+
+import pytest
+import requests
+
+from predictionio_tpu.api import create_event_app
+from predictionio_tpu.storage import Storage
+
+
+class _ServerThread:
+    """Run the aiohttp app on an ephemeral port in a daemon thread."""
+
+    def __init__(self, stats: bool = False):
+        import asyncio
+
+        from aiohttp import web
+
+        self._loop = asyncio.new_event_loop()
+        self._ready = threading.Event()
+        self.port = None
+
+        async def _start():
+            runner = web.AppRunner(create_event_app(stats=stats))
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            self.port = runner.addresses[0][1]
+            self._runner = runner
+            self._ready.set()
+
+        def _run():
+            asyncio.set_event_loop(self._loop)
+            self._loop.run_until_complete(_start())
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=_run, daemon=True)
+        self._thread.start()
+        assert self._ready.wait(10)
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.port}"
+
+    def stop(self):
+        import asyncio
+
+        async def _stop():
+            await self._runner.cleanup()
+            self._loop.stop()
+
+        asyncio.run_coroutine_threadsafe(_stop(), self._loop)
+        self._thread.join(timeout=5)
+
+
+@pytest.fixture
+def server():
+    s = _ServerThread(stats=True)
+    yield s
+    s.stop()
+
+
+@pytest.fixture
+def app_key(server):
+    meta = Storage.get_metadata()
+    app = meta.app_insert("testapp")
+    ak = meta.access_key_insert(app.id)
+    Storage.get_events().init_app(app.id)
+    return app, ak.key
+
+
+EV = {
+    "event": "rate",
+    "entityType": "user",
+    "entityId": "u0",
+    "targetEntityType": "item",
+    "targetEntityId": "i0",
+    "properties": {"rating": 5},
+    "eventTime": "2020-01-01T00:00:00.000Z",
+}
+
+
+def test_root_alive(server):
+    r = requests.get(server.url + "/")
+    assert r.status_code == 200
+    assert r.json() == {"status": "alive"}
+
+
+def test_auth_required(server, app_key):
+    r = requests.post(server.url + "/events.json", json=EV)
+    assert r.status_code == 401
+    r = requests.post(server.url + "/events.json?accessKey=WRONG", json=EV)
+    assert r.status_code == 401
+
+
+def test_post_and_get_event(server, app_key):
+    _, key = app_key
+    r = requests.post(f"{server.url}/events.json?accessKey={key}", json=EV)
+    assert r.status_code == 201
+    event_id = r.json()["eventId"]
+    assert event_id
+
+    r = requests.get(f"{server.url}/events/{event_id}.json?accessKey={key}")
+    assert r.status_code == 200
+    body = r.json()
+    assert body["event"] == "rate"
+    assert body["entityId"] == "u0"
+    assert body["eventTime"] == "2020-01-01T00:00:00Z"
+
+    r = requests.delete(f"{server.url}/events/{event_id}.json?accessKey={key}")
+    assert r.status_code == 200 and r.json() == {"message": "Found"}
+    r = requests.get(f"{server.url}/events/{event_id}.json?accessKey={key}")
+    assert r.status_code == 404
+
+
+def test_post_invalid_event(server, app_key):
+    _, key = app_key
+    bad = dict(EV, event="$badreserved")
+    r = requests.post(f"{server.url}/events.json?accessKey={key}", json=bad)
+    assert r.status_code == 400
+    r = requests.post(
+        f"{server.url}/events.json?accessKey={key}",
+        data="not json",
+        headers={"Content-Type": "application/json"},
+    )
+    assert r.status_code == 400
+
+
+def test_get_events_filters_and_default_limit(server, app_key):
+    _, key = app_key
+    for i in range(25):
+        ev = dict(EV, entityId=f"u{i}", eventTime=f"2020-01-01T00:{i:02d}:00Z")
+        assert requests.post(
+            f"{server.url}/events.json?accessKey={key}", json=ev
+        ).status_code == 201
+    # default limit 20 (EventAPI.scala:253)
+    r = requests.get(f"{server.url}/events.json?accessKey={key}")
+    assert r.status_code == 200 and len(r.json()) == 20
+    r = requests.get(f"{server.url}/events.json?accessKey={key}&limit=-1")
+    assert len(r.json()) == 25
+    r = requests.get(
+        f"{server.url}/events.json?accessKey={key}&entityId=u3&entityType=user"
+    )
+    assert len(r.json()) == 1
+    r = requests.get(f"{server.url}/events.json?accessKey={key}&reversed=true&limit=1")
+    assert r.json()[0]["entityId"] == "u24"
+    # empty result -> 404 per reference
+    r = requests.get(f"{server.url}/events.json?accessKey={key}&event=nope")
+    assert r.status_code == 404
+
+
+def test_batch_events(server, app_key):
+    _, key = app_key
+    batch = [EV, dict(EV, event="$badreserved"), dict(EV, entityId="u9")]
+    r = requests.post(f"{server.url}/batch/events.json?accessKey={key}", json=batch)
+    assert r.status_code == 200
+    results = r.json()
+    assert [x["status"] for x in results] == [201, 400, 201]
+    too_big = [EV] * 51
+    r = requests.post(f"{server.url}/batch/events.json?accessKey={key}", json=too_big)
+    assert r.status_code == 400
+
+
+def test_channel_auth(server, app_key):
+    app, key = app_key
+    meta = Storage.get_metadata()
+    ch = meta.channel_insert(app.id, "mobile")
+    Storage.get_events().init_app(app.id, ch.id)
+    r = requests.post(
+        f"{server.url}/events.json?accessKey={key}&channel=mobile", json=EV
+    )
+    assert r.status_code == 201
+    # channel-scoped read sees it; default channel does not
+    r = requests.get(f"{server.url}/events.json?accessKey={key}&channel=mobile")
+    assert r.status_code == 200 and len(r.json()) == 1
+    r = requests.get(f"{server.url}/events.json?accessKey={key}")
+    assert r.status_code == 404
+    r = requests.post(
+        f"{server.url}/events.json?accessKey={key}&channel=nope", json=EV
+    )
+    assert r.status_code == 401
+
+
+def test_stats(server, app_key):
+    _, key = app_key
+    requests.post(f"{server.url}/events.json?accessKey={key}", json=EV)
+    r = requests.get(f"{server.url}/stats.json?accessKey={key}")
+    assert r.status_code == 200
+    body = r.json()
+    assert body["statusCount"] == {"201": 1}
+    assert body["eteCount"][0]["event"] == "rate"
+    assert body["eteCount"][0]["count"] == 1
+
+
+def test_webhook_segmentio(server, app_key):
+    _, key = app_key
+    payload = {
+        "type": "identify",
+        "userId": "u77",
+        "timestamp": "2020-02-02T00:00:00Z",
+        "traits": {"plan": "pro"},
+    }
+    r = requests.post(
+        f"{server.url}/webhooks/segmentio.json?accessKey={key}", json=payload
+    )
+    assert r.status_code == 201
+    r = requests.get(f"{server.url}/events.json?accessKey={key}&event=identify")
+    assert r.status_code == 200
+    assert r.json()[0]["entityId"] == "u77"
+    # unknown type rejected
+    r = requests.post(
+        f"{server.url}/webhooks/segmentio.json?accessKey={key}",
+        json={"type": "track", "timestamp": "2020-02-02T00:00:00Z"},
+    )
+    assert r.status_code == 400
+    # connector presence check
+    r = requests.get(f"{server.url}/webhooks/segmentio.json?accessKey={key}")
+    assert r.status_code == 200
+    r = requests.get(f"{server.url}/webhooks/nope.json?accessKey={key}")
+    assert r.status_code == 404
+
+
+def test_webhook_mailchimp_form(server, app_key):
+    _, key = app_key
+    form = {
+        "type": "subscribe",
+        "fired_at": "2009-03-26 21:35:57",
+        "data[id]": "8a25ff1d98",
+        "data[list_id]": "a6b5da1054",
+        "data[email]": "api@mailchimp.com",
+        "data[email_type]": "html",
+        "data[merges][EMAIL]": "api@mailchimp.com",
+        "data[merges][FNAME]": "MailChimp",
+        "data[merges][LNAME]": "API",
+        "data[merges][INTERESTS]": "Group1,Group2",
+        "data[ip_opt]": "10.20.10.30",
+        "data[ip_signup]": "10.20.10.30",
+    }
+    r = requests.post(f"{server.url}/webhooks/mailchimp?accessKey={key}", data=form)
+    assert r.status_code == 201
+    r = requests.get(f"{server.url}/events.json?accessKey={key}&event=subscribe")
+    body = r.json()[0]
+    assert body["entityId"] == "8a25ff1d98"
+    assert body["targetEntityId"] == "a6b5da1054"
+    assert body["properties"]["merges"]["FNAME"] == "MailChimp"
+    # missing required field
+    r = requests.post(
+        f"{server.url}/webhooks/mailchimp?accessKey={key}",
+        data={"type": "subscribe"},
+    )
+    assert r.status_code == 400
