@@ -87,6 +87,10 @@ async def _insert_event_dict(
 ) -> tuple[int, dict]:
     """Validate + insert one API-JSON event; returns (status, body)."""
     try:
+        # never trust a client-supplied eventId on the API path — ids are
+        # assigned server-side (the reference's APISerializer doesn't read
+        # eventId either); the bulk-import tool is the only id-preserving path
+        data = {k: v for k, v in data.items() if k != "eventId"}
         event = event_from_api_dict(data)
     except ValidationError as e:
         return 400, {"message": str(e)}

@@ -77,8 +77,8 @@ class Stats:
             ete_count: Counter = Counter()
             start = None
             for bucket, c in self._buckets.items():
-                if (current - bucket).total_seconds() > 7200:
-                    continue
+                if (current - bucket).total_seconds() > 3600:
+                    continue  # keep only the current + previous hour
                 start = bucket if start is None else min(start, bucket)
                 for (aid, status, ete), n in c.items():
                     if aid != app_id:

@@ -1,0 +1,49 @@
+"""Controller API (L4): the DASE contracts engine templates implement.
+
+Mirrors the capability of reference core/src/main/scala/io/prediction/
+controller/ with a single protocol set (see components.py for why the
+P/L split collapses on TPU).
+"""
+
+from .components import (
+    Algorithm,
+    AverageServing,
+    DataSource,
+    Doer,
+    FirstServing,
+    IdentityPreparator,
+    PersistentModel,
+    Preparator,
+    SanityCheck,
+    Serving,
+)
+from .engine import Engine, EngineFactory, EvalFold, TrainResult
+from .evaluation import (
+    EngineParamsGenerator,
+    Evaluation,
+    MetricEvaluator,
+    MetricEvaluatorResult,
+    MetricScores,
+)
+from .fast_eval import FastEvalEngine
+from .metric import (
+    AverageMetric,
+    Metric,
+    OptionAverageMetric,
+    OptionStdevMetric,
+    StdevMetric,
+    SumMetric,
+    ZeroMetric,
+)
+from .params import EmptyParams, EngineParams, Params, parse_params, params_to_json
+
+__all__ = [
+    "Algorithm", "AverageMetric", "AverageServing", "DataSource", "Doer",
+    "EmptyParams", "Engine", "EngineFactory", "EngineParams",
+    "EngineParamsGenerator", "EvalFold", "Evaluation", "FastEvalEngine",
+    "FirstServing", "IdentityPreparator", "Metric", "MetricEvaluator",
+    "MetricEvaluatorResult", "MetricScores", "OptionAverageMetric",
+    "OptionStdevMetric", "Params", "PersistentModel", "Preparator",
+    "SanityCheck", "Serving", "StdevMetric", "SumMetric", "TrainResult",
+    "ZeroMetric", "params_to_json", "parse_params",
+]

@@ -1,0 +1,171 @@
+"""Evaluation declarations + the MetricEvaluator leaderboard.
+
+Analog of reference ``Evaluation`` (core/src/main/scala/io/prediction/
+controller/Evaluation.scala:32-97), ``MetricEvaluator``
+(MetricEvaluator.scala:36-222) and ``EngineParamsGenerator``
+(EngineParamsGenerator.scala).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+from typing import Any, Sequence
+
+from .engine import Engine, EvalFold
+from .metric import Metric
+from .params import EngineParams
+
+log = logging.getLogger("predictionio_tpu.evaluation")
+
+__all__ = [
+    "Evaluation", "EngineParamsGenerator", "MetricEvaluator",
+    "MetricScores", "MetricEvaluatorResult",
+]
+
+
+class EngineParamsGenerator:
+    """Supplies the EngineParams grid for tuning. Subclass and set
+    ``engine_params_list``."""
+
+    engine_params_list: Sequence[EngineParams] = ()
+
+
+class Evaluation:
+    """Pairs an engine with metrics. Subclass and set ``engine`` plus either
+    ``metric`` (+ optional ``metrics``) — mirroring the reference's
+    ``engineMetric =`` setter DSL (Evaluation.scala:45-97)."""
+
+    engine: Engine = None  # type: ignore[assignment]
+    metric: Metric = None  # type: ignore[assignment]
+    metrics: Sequence[Metric] = ()
+
+    @property
+    def all_metrics(self) -> list[Metric]:
+        out = [self.metric] if self.metric is not None else []
+        out.extend(m for m in self.metrics if m is not self.metric)
+        if not out:
+            raise ValueError(f"{type(self).__name__} defines no metric")
+        return out
+
+
+@dataclasses.dataclass
+class MetricScores:
+    """(MetricEvaluator.scala:36-44)"""
+
+    score: Any
+    other_scores: list[Any]
+
+    def to_row(self) -> list:
+        return [self.score, *self.other_scores]
+
+
+@dataclasses.dataclass
+class MetricEvaluatorResult:
+    """(MetricEvaluator.scala:46-88)"""
+
+    best_score: MetricScores
+    best_engine_params: EngineParams
+    best_idx: int
+    metric_header: str
+    other_metric_headers: list[str]
+    engine_params_scores: list[tuple[EngineParams, MetricScores]]
+
+    def to_one_liner(self) -> str:
+        return f"[{self.best_score.score}] {self.best_engine_params.to_json_dict()['algorithmsParams']}"
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "metricHeader": self.metric_header,
+                "otherMetricHeaders": self.other_metric_headers,
+                "bestScore": self.best_score.to_row(),
+                "bestEngineParams": self.best_engine_params.to_json_dict(),
+                "bestIdx": self.best_idx,
+                "engineParamsScores": [
+                    {"engineParams": ep.to_json_dict(), "score": ms.to_row()}
+                    for ep, ms in self.engine_params_scores
+                ],
+            },
+            default=str,
+        )
+
+    def to_html(self) -> str:
+        rows = "\n".join(
+            "<tr><td>{}</td><td>{}</td><td><pre>{}</pre></td></tr>".format(
+                i,
+                " | ".join(str(s) for s in ms.to_row()),
+                json.dumps(ep.to_json_dict(), indent=2),
+            )
+            for i, (ep, ms) in enumerate(self.engine_params_scores)
+        )
+        return (
+            "<html><body><h1>Metric Evaluator Results</h1>"
+            f"<p>Best variant: #{self.best_idx}, "
+            f"{self.metric_header} = {self.best_score.score}</p>"
+            f"<table border=1><tr><th>#</th><th>{self.metric_header} | "
+            + " | ".join(self.other_metric_headers)
+            + "</th><th>params</th></tr>"
+            + rows
+            + "</table></body></html>"
+        )
+
+    def pretty_print(self) -> str:
+        lines = ["MetricEvaluator leaderboard:"]
+        order = sorted(
+            range(len(self.engine_params_scores)),
+            key=lambda i: self.engine_params_scores[i][1].score,
+            reverse=True,
+        )
+        for rank, i in enumerate(order):
+            ep, ms = self.engine_params_scores[i]
+            star = " <== BEST" if i == self.best_idx else ""
+            lines.append(
+                f"  {rank + 1:2d}. [{self.metric_header}={ms.score}] variant #{i}{star}"
+            )
+        return "\n".join(lines)
+
+
+class MetricEvaluator:
+    """Run metrics over batch-eval output; rank variants by the primary
+    metric (MetricEvaluator.evaluateBase, MetricEvaluator.scala:177-221).
+    Optionally writes the best variant as engine.json to ``best_json_path``
+    (saveEngineJson, :152-175)."""
+
+    def __init__(self, metric: Metric, other_metrics: Sequence[Metric] = (),
+                 best_json_path: str | None = None):
+        self.metric = metric
+        self.other_metrics = list(other_metrics)
+        self.best_json_path = best_json_path
+
+    def evaluate(
+        self,
+        ctx,
+        results: Sequence[tuple[EngineParams, list[EvalFold]]],
+    ) -> MetricEvaluatorResult:
+        scored: list[tuple[EngineParams, MetricScores]] = []
+        for ep, folds in results:
+            fold_tuples = [(f.eval_info, f.qpa) for f in folds]
+            score = self.metric.calculate(ctx, fold_tuples)
+            others = [m.calculate(ctx, fold_tuples) for m in self.other_metrics]
+            log.info("Variant scored: %s = %s", self.metric.header(), score)
+            scored.append((ep, MetricScores(score, others)))
+
+        best_idx = max(
+            range(len(scored)),
+            key=lambda i: self.metric.compare_key(scored[i][1].score),
+        )
+        result = MetricEvaluatorResult(
+            best_score=scored[best_idx][1],
+            best_engine_params=scored[best_idx][0],
+            best_idx=best_idx,
+            metric_header=self.metric.header(),
+            other_metric_headers=[m.header() for m in self.other_metrics],
+            engine_params_scores=scored,
+        )
+        if self.best_json_path:
+            with open(self.best_json_path, "w") as f:
+                json.dump(result.best_engine_params.to_json_dict(), f, indent=2, default=str)
+            log.info("Best engine params written to %s", self.best_json_path)
+        return result

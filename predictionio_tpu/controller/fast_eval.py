@@ -1,0 +1,106 @@
+"""FastEvalEngine: pipeline-prefix memoization for hyperparameter tuning.
+
+Analog of reference ``FastEvalEngine``/``FastEvalEngineWorkflow`` (core/src/
+main/scala/io/prediction/controller/FastEvalEngine.scala:38-330): when a
+grid of EngineParams variants shares pipeline prefixes (same datasource
+params -> same folds; same +preparator params -> same prepared data; same
++algorithms params -> same models), each distinct prefix computes once.
+
+The reference builds this from four Prefix case classes and mutable
+HashMaps keyed by them; here the memo keys are the canonical params-JSON
+of each prefix — no class ceremony, identical hit behavior. Cache-hit
+counts are exposed for tests (the reference's FastEvalEngineTest asserts
+reuse counts the same way).
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import Counter
+from typing import Any, Sequence
+
+from .engine import Engine, EvalFold
+from .params import EngineParams, params_to_json
+
+log = logging.getLogger("predictionio_tpu.fast_eval")
+
+__all__ = ["FastEvalEngine"]
+
+
+def _key(*parts: Any) -> str:
+    return "|".join(params_to_json(("", p) if not isinstance(p, tuple) else p) for p in parts)
+
+
+class FastEvalEngine(Engine):
+    """Engine whose ``batch_eval`` memoizes pipeline prefixes. Not for
+    deployment (the reference throws on train, FastEvalEngine.scala:303-308;
+    ``train`` here likewise refuses)."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.hit_counts: Counter = Counter()
+        self._ds_cache: dict[str, list] = {}
+        self._prep_cache: dict[str, list] = {}
+        self._algo_cache: dict[str, list] = {}
+
+    def train(self, ctx, engine_params: EngineParams):
+        raise RuntimeError(
+            "FastEvalEngine is for evaluation only; use Engine for deployment "
+            "(reference FastEvalEngine.scala:303-308)"
+        )
+
+    # -- memoized prefix stages (FastEvalEngineWorkflow.get* :80-292) ------
+    def _folds(self, ctx, ep: EngineParams) -> list:
+        k = _key(ep.data_source_params)
+        if k not in self._ds_cache:
+            ds = self.make_data_source(ep)
+            self._ds_cache[k] = ds.read_eval(ctx)
+        else:
+            self.hit_counts["datasource"] += 1
+        return self._ds_cache[k]
+
+    def _prepared(self, ctx, ep: EngineParams) -> list:
+        k = _key(ep.data_source_params, ep.preparator_params)
+        if k not in self._prep_cache:
+            folds = self._folds(ctx, ep)
+            prep = self.make_preparator(ep)
+            self._prep_cache[k] = [
+                (prep.prepare(ctx, td), ei, qa) for td, ei, qa in folds
+            ]
+        else:
+            self.hit_counts["preparator"] += 1
+        return self._prep_cache[k]
+
+    def _models(self, ctx, ep: EngineParams, prepared: list) -> list:
+        k = _key(ep.data_source_params, ep.preparator_params,
+                 *ep.algorithm_params_list)
+        if k not in self._algo_cache:
+            _names, algos = self.make_algorithms(ep)
+            self._algo_cache[k] = [
+                [algo.train(ctx, pd) for algo in algos]
+                for pd, _ei, _qa in prepared
+            ]
+        else:
+            self.hit_counts["algorithms"] += 1
+        return self._algo_cache[k]
+
+    def eval(self, ctx, engine_params: EngineParams) -> list[EvalFold]:
+        prepared = self._prepared(ctx, engine_params)
+        per_fold_models = self._models(ctx, engine_params, prepared)
+        _names, algos = self.make_algorithms(engine_params)
+        serving = self.make_serving(engine_params)
+        out: list[EvalFold] = []
+        for (pd, eval_info, qa), models in zip(prepared, per_fold_models):
+            indexed = [(i, q) for i, (q, _a) in enumerate(qa)]
+            per_algo = [dict(a.batch_predict(m, indexed)) for a, m in zip(algos, models)]
+            qpa = [
+                (q, serving.serve(q, [preds[i] for preds in per_algo]), a)
+                for i, (q, a) in enumerate(qa)
+            ]
+            out.append(EvalFold(eval_info, qpa))
+        return out
+
+    def batch_eval(
+        self, ctx, engine_params_list: Sequence[EngineParams]
+    ) -> list[tuple[EngineParams, list[EvalFold]]]:
+        return [(ep, self.eval(ctx, ep)) for ep in engine_params_list]

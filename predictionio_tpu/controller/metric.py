@@ -1,0 +1,106 @@
+"""Metrics for evaluation/tuning.
+
+Analog of reference ``Metric`` + aggregate helpers (reference: core/src/main/
+scala/io/prediction/controller/Metric.scala:133-300). The reference computes
+means/stdevs with Spark's ``StatCounter`` over RDDs of scores; here scores
+are numpy vectors — one ``np.mean`` replaces the distributed fold.
+
+A Metric sees the whole evaluation output: ``[(eval_info, [(q, p, a), ...])]``
+per fold, and returns a comparable result (higher is better by default;
+set ``lower_is_better=True`` to flip, the reference's custom Ordering).
+"""
+
+from __future__ import annotations
+
+import abc
+import math
+from typing import Any, Generic, Sequence, TypeVar
+
+import numpy as np
+
+EI = TypeVar("EI")
+Q = TypeVar("Q")
+P = TypeVar("P")
+A = TypeVar("A")
+R = TypeVar("R")
+
+__all__ = [
+    "Metric", "AverageMetric", "OptionAverageMetric", "StdevMetric",
+    "OptionStdevMetric", "SumMetric", "ZeroMetric",
+]
+
+Folds = Sequence[tuple[Any, Sequence[tuple[Any, Any, Any]]]]
+
+
+class Metric(abc.ABC, Generic[R]):
+    """(Metric.scala:133-160)"""
+
+    lower_is_better: bool = False
+
+    @abc.abstractmethod
+    def calculate(self, ctx, folds: Folds) -> R:
+        ...
+
+    def header(self) -> str:
+        return type(self).__name__
+
+    def compare_key(self, result: R):
+        """Sort key making 'better' larger."""
+        return -result if self.lower_is_better else result  # type: ignore[operator]
+
+
+class _PerQPAMetric(Metric[float]):
+    """Shared scaffolding: score every (q, p, a) across folds."""
+
+    @abc.abstractmethod
+    def calculate_qpa(self, q, p, a) -> float | None:
+        ...
+
+    def _scores(self, folds: Folds) -> np.ndarray:
+        vals = [
+            s
+            for _ei, qpa in folds
+            for q, p, a in qpa
+            if (s := self.calculate_qpa(q, p, a)) is not None
+        ]
+        return np.asarray(vals, dtype=np.float64)
+
+
+class AverageMetric(_PerQPAMetric):
+    """Mean score over all folds (Metric.scala:184-207)."""
+
+    def calculate(self, ctx, folds: Folds) -> float:
+        s = self._scores(folds)
+        return float(np.mean(s)) if s.size else float("nan")
+
+
+class OptionAverageMetric(AverageMetric):
+    """Mean over defined scores only (Metric.scala:209-234). Semantics are
+    already optional here (return None to skip); alias kept for parity."""
+
+
+class StdevMetric(_PerQPAMetric):
+    """Population stdev of scores (Metric.scala:236-262)."""
+
+    def calculate(self, ctx, folds: Folds) -> float:
+        s = self._scores(folds)
+        return float(np.std(s)) if s.size else float("nan")
+
+
+class OptionStdevMetric(StdevMetric):
+    """(Metric.scala:264-278)"""
+
+
+class SumMetric(_PerQPAMetric):
+    """Sum of scores (Metric.scala:280-300)."""
+
+    def calculate(self, ctx, folds: Folds) -> float:
+        s = self._scores(folds)
+        return float(np.sum(s)) if s.size else 0.0
+
+
+class ZeroMetric(Metric[float]):
+    """Always 0 — placeholder (reference ZeroMetric in Evaluation.scala)."""
+
+    def calculate(self, ctx, folds: Folds) -> float:
+        return 0.0

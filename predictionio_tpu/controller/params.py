@@ -1,0 +1,101 @@
+"""Parameter system: typed params dataclasses + EngineParams.
+
+The reference types component parameters as ``Params`` case classes
+extracted from engine.json via json4s reflection (reference: core/src/main/
+scala/io/prediction/controller/Params.scala, WorkflowUtils.extractParams,
+workflow/WorkflowUtils.scala:129-160). Here components declare a params
+dataclass; JSON dicts are parsed into it with explicit field checks — no
+reflection magic, same engine.json compatibility.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field, is_dataclass
+from typing import Any, Mapping, Sequence, Type, TypeVar
+
+__all__ = ["Params", "EmptyParams", "EngineParams", "parse_params", "params_to_json"]
+
+P = TypeVar("P")
+
+
+@dataclass(frozen=True)
+class Params:
+    """Base marker for component parameter dataclasses (Params.scala:30)."""
+
+
+@dataclass(frozen=True)
+class EmptyParams(Params):
+    pass
+
+
+def parse_params(cls: Type[P], data: Mapping[str, Any] | None) -> P:
+    """JSON dict -> params dataclass. Unknown keys are rejected (catching
+    engine.json typos — stricter than the reference, which silently drops
+    them); missing keys fall back to dataclass defaults; missing required
+    keys raise."""
+    data = dict(data or {})
+    if not is_dataclass(cls):
+        raise TypeError(f"{cls!r} is not a params dataclass")
+    names = {f.name for f in dataclasses.fields(cls)}
+    unknown = set(data) - names
+    if unknown:
+        raise ValueError(
+            f"unknown parameter(s) {sorted(unknown)} for {cls.__name__}; "
+            f"expected a subset of {sorted(names)}"
+        )
+    try:
+        return cls(**data)  # type: ignore[call-arg]
+    except TypeError as e:
+        raise ValueError(f"cannot construct {cls.__name__} from {data}: {e}") from e
+
+
+def params_to_json(p: Any) -> str:
+    if p is None:
+        return "{}"
+    if is_dataclass(p) and not isinstance(p, type):
+        return json.dumps(dataclasses.asdict(p), sort_keys=True, default=str)
+    return json.dumps(p, sort_keys=True, default=str)
+
+
+@dataclass(frozen=True)
+class EngineParams:
+    """One training's full parameter set (reference: controller/
+    EngineParams.scala:31-113): named (component, params) pairs for
+    datasource/preparator/serving and an ordered list for algorithms."""
+
+    data_source_params: tuple[str, Any] = ("", EmptyParams())
+    preparator_params: tuple[str, Any] = ("", EmptyParams())
+    algorithm_params_list: tuple[tuple[str, Any], ...] = ()
+    serving_params: tuple[str, Any] = ("", EmptyParams())
+
+    def __post_init__(self):
+        object.__setattr__(
+            self, "algorithm_params_list", tuple(self.algorithm_params_list)
+        )
+
+    # reference EngineParams builder-style copy helpers
+    def with_data_source(self, name: str, params: Any) -> "EngineParams":
+        return dataclasses.replace(self, data_source_params=(name, params))
+
+    def with_preparator(self, name: str, params: Any) -> "EngineParams":
+        return dataclasses.replace(self, preparator_params=(name, params))
+
+    def with_algorithms(self, *pairs: tuple[str, Any]) -> "EngineParams":
+        return dataclasses.replace(self, algorithm_params_list=tuple(pairs))
+
+    def with_serving(self, name: str, params: Any) -> "EngineParams":
+        return dataclasses.replace(self, serving_params=(name, params))
+
+    def to_json_dict(self) -> dict:
+        def pair(t):
+            name, p = t
+            return {"name": name, "params": json.loads(params_to_json(p))}
+
+        return {
+            "dataSourceParams": pair(self.data_source_params),
+            "preparatorParams": pair(self.preparator_params),
+            "algorithmsParams": [pair(t) for t in self.algorithm_params_list],
+            "servingParams": pair(self.serving_params),
+        }

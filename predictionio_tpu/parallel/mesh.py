@@ -1,0 +1,112 @@
+"""Device mesh construction + sharding helpers.
+
+The communication-backend layer of the framework: where the reference
+scales via Spark's shuffle/netty (implicit in every RDD op), this framework
+scales via XLA collectives over ICI/DCN, organized by a
+``jax.sharding.Mesh``. Everything that shards arrays goes through here.
+
+Axis convention (used by all built-in algorithms):
+- ``"data"``  — batch / example sharding (DP); gradients and statistics
+  psum over it.
+- ``"model"`` — parameter sharding (TP / factor sharding for ALS).
+
+Multi-host: call ``init_distributed()`` once per process before building a
+mesh; ``jax.devices()`` then spans all hosts and collectives ride DCN
+between slices (the jax.distributed runtime replaces the reference's
+driver<->executor akka control plane).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger("predictionio_tpu.parallel")
+
+__all__ = [
+    "make_mesh", "data_sharding", "replicated", "shard_batch",
+    "init_distributed", "local_device_count",
+]
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Multi-host bring-up (DCN control plane). No-op when single-process
+    env vars are absent and no args are given."""
+    import jax
+
+    if coordinator_address is None and "JAX_COORDINATOR_ADDRESS" not in os.environ:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    log.info(
+        "jax.distributed initialized: process %d/%d",
+        jax.process_index(), jax.process_count(),
+    )
+
+
+def local_device_count() -> int:
+    import jax
+
+    return len(jax.devices())
+
+
+def make_mesh(shape: tuple[int, ...] | None = None,
+              axes: tuple[str, ...] | None = None):
+    """Build a Mesh over all devices. Default: 1-D ("data",) over every
+    device. ``shape`` may use -1 for one inferred dimension."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devices = jax.devices()
+    n = len(devices)
+    if shape is None:
+        shape = (n,)
+        axes = axes or ("data",)
+    else:
+        axes = axes or tuple(f"axis{i}" for i in range(len(shape)))
+        shape = tuple(shape)
+        if -1 in shape:
+            known = int(np.prod([s for s in shape if s != -1]))
+            shape = tuple(n // known if s == -1 else s for s in shape)
+    total = int(np.prod(shape))
+    if total > n:
+        raise ValueError(f"mesh shape {shape} needs {total} devices, have {n}")
+    dev_array = np.asarray(devices[:total]).reshape(shape)
+    return Mesh(dev_array, axes)
+
+
+def data_sharding(mesh, *, axis: str = "data"):
+    """NamedSharding putting dim 0 on the data axis, rest replicated."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard_batch(mesh, array, *, axis: str = "data"):
+    """Pad dim 0 to a multiple of the axis size and device_put sharded.
+    Returns (sharded_array, original_length). This is the host->HBM hop
+    that replaces the reference's HBase-scan-to-RDD boundary."""
+    import jax
+    import numpy as np
+
+    n = array.shape[0]
+    per = mesh.shape[axis]
+    padded = ((n + per - 1) // per) * per
+    if padded != n:
+        pad_width = [(0, padded - n)] + [(0, 0)] * (array.ndim - 1)
+        array = np.pad(array, pad_width)
+    return jax.device_put(array, data_sharding(mesh, axis=axis)), n

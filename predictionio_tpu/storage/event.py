@@ -178,6 +178,14 @@ def event_from_api_dict(d: Mapping[str, Any]) -> Event:
     for name in ("event", "entityType", "entityId"):
         if not isinstance(d[name], str):
             raise ValidationError(f"field {name} must be a string")
+    for name in ("targetEntityType", "targetEntityId", "prId", "eventId"):
+        if d.get(name) is not None and not isinstance(d[name], str):
+            raise ValidationError(f"field {name} must be a string")
+    tags = d.get("tags", ())
+    if isinstance(tags, str) or not isinstance(tags, (list, tuple)):
+        raise ValidationError("field tags must be a JSON array of strings")
+    if any(not isinstance(t, str) for t in tags):
+        raise ValidationError("field tags must be a JSON array of strings")
     props = d.get("properties", {})
     if not isinstance(props, Mapping):
         raise ValidationError("field properties must be a JSON object")

@@ -72,6 +72,16 @@ class EventQuery:
     limit: int | None = None
     reversed: bool = False
 
+    def __post_init__(self):
+        # naive datetimes are treated as UTC, matching Event.__post_init__ —
+        # otherwise backends would compare/encode them in server-local time
+        from datetime import timezone
+
+        for name in ("start_time", "until_time"):
+            t = getattr(self, name)
+            if t is not None and t.tzinfo is None:
+                object.__setattr__(self, name, t.replace(tzinfo=timezone.utc))
+
     def matches(self, e: Event) -> bool:
         if self.start_time is not None and e.event_time < self.start_time:
             return False

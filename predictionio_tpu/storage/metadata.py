@@ -168,14 +168,20 @@ class MetadataStore:
         self._local = threading.local()
         self._lock = threading.RLock()
         self._shared = LockedConnection(path, self._lock) if self._memory else None
+        self._all_conns: list = []
+        self._closed = False
         self._init_schema()
 
     def _conn(self) -> sqlite3.Connection:
+        if self._closed:
+            raise RuntimeError(f"{type(self).__name__} is closed")
         if self._shared is not None:
             return self._shared
         conn = getattr(self._local, "conn", None)
         if conn is None:
             conn = sqlite3.connect(self._path, timeout=30.0)
+            with self._lock:
+                self._all_conns.append(conn)
             conn.execute("PRAGMA journal_mode=WAL")
             self._local.conn = conn
         return conn
@@ -208,10 +214,15 @@ class MetadataStore:
             c.commit()
 
     def close(self) -> None:
-        conn = getattr(self._local, "conn", None)
-        if conn is not None:
-            conn.close()
-            self._local.conn = None
+        self._closed = True
+        with self._lock:
+            for conn in self._all_conns:
+                try:
+                    conn.close()
+                except sqlite3.ProgrammingError:
+                    pass  # a conn owned by a live worker thread; dropped at exit
+            self._all_conns.clear()
+        self._local.conn = None
         if self._shared is not None:
             self._shared.close()
             self._shared = None
@@ -276,13 +287,20 @@ class MetadataStore:
             return cur.rowcount > 0
 
     # -- access keys (AccessKeys.scala:37-77) -----------------------------
-    def access_key_insert(self, appid: int, events: tuple[str, ...] = (), key: str | None = None) -> AccessKey:
+    def access_key_insert(
+        self, appid: int, events: tuple[str, ...] = (), key: str | None = None
+    ) -> AccessKey | None:
+        """None on duplicate caller-chosen key (same conflict contract as
+        app_insert/channel_insert)."""
         ak = AccessKey(key=key or secrets.token_urlsafe(32), appid=appid, events=tuple(events))
         c = self._conn()
         with self._lock:
-            c.execute(
-                "INSERT INTO access_keys VALUES (?, ?, ?)", (ak.key, appid, _ser(ak))
-            )
+            try:
+                c.execute(
+                    "INSERT INTO access_keys VALUES (?, ?, ?)", (ak.key, appid, _ser(ak))
+                )
+            except sqlite3.IntegrityError:
+                return None
             c.commit()
         return ak
 

@@ -66,15 +66,21 @@ class SQLiteEvents(EventBackend):
         self._local = threading.local()
         self._lock = threading.RLock()
         self._shared = LockedConnection(path, self._lock) if self._memory else None
+        self._all_conns: list = []
+        self._closed = False
         self._known_tables: set[str] = set()
         self._seq = 0
 
     def _conn(self) -> sqlite3.Connection:
+        if self._closed:
+            raise RuntimeError(f"{type(self).__name__} is closed")
         if self._shared is not None:
             return self._shared
         conn = getattr(self._local, "conn", None)
         if conn is None:
             conn = sqlite3.connect(self._path, timeout=30.0)
+            with self._lock:
+                self._all_conns.append(conn)
             conn.execute("PRAGMA journal_mode=WAL")
             conn.execute("PRAGMA synchronous=NORMAL")
             self._local.conn = conn
@@ -120,10 +126,15 @@ class SQLiteEvents(EventBackend):
         return True
 
     def close(self) -> None:
-        conn = getattr(self._local, "conn", None)
-        if conn is not None:
-            conn.close()
-            self._local.conn = None
+        self._closed = True
+        with self._lock:
+            for conn in self._all_conns:
+                try:
+                    conn.close()
+                except sqlite3.ProgrammingError:
+                    pass  # a conn owned by a live worker thread; dropped at exit
+            self._all_conns.clear()
+        self._local.conn = None
         if self._shared is not None:
             self._shared.close()
             self._shared = None

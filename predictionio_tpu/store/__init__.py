@@ -1,0 +1,6 @@
+"""Event store facade (L2) — what engine templates read (reference:
+data/src/main/scala/io/prediction/data/store/)."""
+
+from .event_store import EventStore, app_name_to_id
+
+__all__ = ["EventStore", "app_name_to_id"]

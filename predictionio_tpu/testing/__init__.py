@@ -1,0 +1,25 @@
+"""Test/dev fixtures (the reference ships FakeWorkflow + SampleEngine for
+this purpose; core/src/test/.../controller/SampleEngine.scala)."""
+
+from .sample_engine import (
+    SampleActual,
+    SampleAlgoParams,
+    SampleAlgorithm,
+    SampleDataSource,
+    SampleDataSourceParams,
+    SampleEngine,
+    SamplePreparator,
+    SamplePrediction,
+    SampleQuery,
+    SampleServing,
+    SampleTrainingData,
+    UnserializableAlgorithm,
+    make_sample_engine,
+)
+
+__all__ = [
+    "SampleActual", "SampleAlgoParams", "SampleAlgorithm", "SampleDataSource",
+    "SampleDataSourceParams", "SampleEngine", "SamplePreparator",
+    "SamplePrediction", "SampleQuery", "SampleServing", "SampleTrainingData",
+    "UnserializableAlgorithm", "make_sample_engine",
+]

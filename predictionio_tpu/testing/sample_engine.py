@@ -1,0 +1,173 @@
+"""Id-stamping fake DASE components for pipeline-wiring tests.
+
+Analog of reference ``SampleEngine.scala`` (core/src/test/scala/io/
+prediction/controller/SampleEngine.scala:12-463): every stage stamps its
+identity into the data flowing through, so tests can assert the exact
+wiring (which datasource fed which preparator fed which algorithms), the
+sanity-check gates, and the eval join — without any storage or devices.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    EngineParams,
+    Params,
+    Preparator,
+    SanityCheck,
+    Serving,
+)
+
+__all__ = [
+    "SampleDataSourceParams", "SampleAlgoParams", "SampleTrainingData",
+    "SampleQuery", "SamplePrediction", "SampleActual", "SampleDataSource",
+    "SamplePreparator", "SampleAlgorithm", "UnserializableAlgorithm",
+    "SampleServing", "SampleEngine", "make_sample_engine",
+]
+
+
+@dataclass(frozen=True)
+class SampleDataSourceParams(Params):
+    id: int = 0
+    n_folds: int = 0  # 0 => no eval data
+    n_queries: int = 4
+    error: bool = False  # trip the sanity check (SampleEngine.scala:15-20)
+
+
+@dataclass(frozen=True)
+class SampleAlgoParams(Params):
+    id: int = 0
+    multiplier: int = 1
+
+
+@dataclass(frozen=True)
+class SampleTrainingData(SanityCheck):
+    ds_id: int
+    error: bool = False
+
+    def sanity_check(self) -> None:
+        if self.error:
+            raise ValueError("TrainingData sanity check failed (error flag)")
+
+
+@dataclass(frozen=True)
+class SamplePreparedData:
+    ds_id: int
+    prep_id: int
+
+
+@dataclass(frozen=True)
+class SampleQuery:
+    q: int
+
+
+@dataclass(frozen=True)
+class SamplePrediction:
+    ds_id: int
+    prep_id: int
+    algo_ids: tuple[int, ...]
+    value: int
+
+
+@dataclass(frozen=True)
+class SampleActual:
+    a: int
+
+
+class SampleDataSource(DataSource):
+    params_class = SampleDataSourceParams
+
+    def read_training(self, ctx) -> SampleTrainingData:
+        return SampleTrainingData(ds_id=self.params.id, error=self.params.error)
+
+    def read_eval(self, ctx):
+        folds = []
+        for _fold in range(self.params.n_folds):
+            td = SampleTrainingData(ds_id=self.params.id, error=self.params.error)
+            qa = [(SampleQuery(q=i), SampleActual(a=i)) for i in range(self.params.n_queries)]
+            folds.append((td, {"fold": _fold}, qa))
+        return folds
+
+
+class SamplePreparator(Preparator):
+    prep_id = 1
+
+    def prepare(self, ctx, td: SampleTrainingData) -> SamplePreparedData:
+        return SamplePreparedData(ds_id=td.ds_id, prep_id=self.prep_id)
+
+
+@dataclass
+class SampleModel:
+    ds_id: int
+    prep_id: int
+    algo_id: int
+    multiplier: int
+
+
+class SampleAlgorithm(Algorithm):
+    params_class = SampleAlgoParams
+
+    def train(self, ctx, pd: SamplePreparedData) -> SampleModel:
+        return SampleModel(
+            ds_id=pd.ds_id, prep_id=pd.prep_id,
+            algo_id=self.params.id, multiplier=self.params.multiplier,
+        )
+
+    def predict(self, model: SampleModel, query: SampleQuery) -> SamplePrediction:
+        return SamplePrediction(
+            ds_id=model.ds_id, prep_id=model.prep_id,
+            algo_ids=(model.algo_id,), value=query.q * model.multiplier,
+        )
+
+
+class UnserializableAlgorithm(SampleAlgorithm):
+    """The 'parallel model, retrain at deploy' path
+    (reference PAlgorithm.makePersistentModel returning Unit)."""
+
+    persist_model = False
+
+
+class SampleServing(Serving):
+    def serve(self, query, predictions):
+        # combine: concatenate algo ids, sum values (LFirstServing analog
+        # would take predictions[0]; summing proves all algos reached here)
+        return SamplePrediction(
+            ds_id=predictions[0].ds_id,
+            prep_id=predictions[0].prep_id,
+            algo_ids=tuple(i for p in predictions for i in p.algo_ids),
+            value=sum(p.value for p in predictions),
+        )
+
+
+class SampleEngine:
+    """EngineFactory-style entry usable by resolve_engine_factory."""
+
+    @staticmethod
+    def apply() -> Engine:
+        return make_sample_engine()
+
+
+def make_sample_engine(unserializable: bool = False) -> Engine:
+    return Engine(
+        data_source_classes=SampleDataSource,
+        preparator_classes=SamplePreparator,
+        algorithm_classes={
+            "sample": SampleAlgorithm,
+            "unser": UnserializableAlgorithm,
+        },
+        serving_classes=SampleServing,
+    )
+
+
+def sample_engine_params(
+    ds_id: int = 7, algos: tuple[tuple[str, SampleAlgoParams], ...] | None = None,
+    n_folds: int = 0, error: bool = False,
+) -> EngineParams:
+    return EngineParams(
+        data_source_params=("", SampleDataSourceParams(id=ds_id, n_folds=n_folds, error=error)),
+        algorithm_params_list=algos or (("sample", SampleAlgoParams(id=1)),),
+    )

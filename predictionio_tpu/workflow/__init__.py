@@ -1,0 +1,24 @@
+"""Workflow runtime (L5): train/eval orchestration, context, persistence."""
+
+from .context import Context, WorkflowParams
+from .core_workflow import (
+    engine_params_from_instance,
+    prepare_deploy,
+    resolve_attr,
+    resolve_engine_factory,
+    run_evaluation,
+    run_train,
+)
+from .serialization import (
+    PersistentModelManifest,
+    RetrainMarker,
+    deserialize_models,
+    serialize_models,
+)
+
+__all__ = [
+    "Context", "PersistentModelManifest", "RetrainMarker", "WorkflowParams",
+    "deserialize_models", "engine_params_from_instance", "prepare_deploy",
+    "resolve_attr", "resolve_engine_factory", "run_evaluation", "run_train",
+    "serialize_models",
+]

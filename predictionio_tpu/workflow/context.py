@@ -1,0 +1,90 @@
+"""Workflow context — the SparkContext analog.
+
+The reference threads a ``SparkContext`` through every DASE method
+(reference: core/src/main/scala/io/prediction/core/BaseDataSource.scala:76,
+workflow/WorkflowContext.scala:25-44). The TPU runtime's ambient state is a
+``jax.sharding.Mesh`` + rng seed + workflow knobs; components receive this
+``Context`` as their first work-method argument.
+
+The mesh is constructed lazily from the available devices: a 1-D
+``("data",)`` mesh by default (pure data parallel), or the axis spec given
+in ``mesh_shape``/``mesh_axes`` (e.g. ``(4, 2), ("data", "model")``).
+Under ``jit``-less unit tests this still works — components may ignore the
+mesh entirely.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Mapping
+
+log = logging.getLogger("predictionio_tpu.workflow")
+
+__all__ = ["Context", "WorkflowParams"]
+
+
+@dataclasses.dataclass
+class WorkflowParams:
+    """(reference: workflow/WorkflowParams.scala)"""
+
+    batch: str = ""
+    verbose: int = 2
+    skip_sanity_check: bool = False
+    stop_after_read: bool = False
+    stop_after_prepare: bool = False
+    #: backend tuning (the reference's sparkEnv); e.g. donate_buffers, seed
+    backend_env: dict = dataclasses.field(default_factory=dict)
+
+
+class Context:
+    """Ambient run state: device mesh, rng seed, app binding, knobs."""
+
+    def __init__(
+        self,
+        mode: str = "",
+        batch: str = "",
+        workflow_params: WorkflowParams | None = None,
+        mesh_shape: tuple[int, ...] | None = None,
+        mesh_axes: tuple[str, ...] | None = None,
+        seed: int = 0,
+        app_name: str | None = None,
+        channel_name: str | None = None,
+        extra: Mapping[str, Any] | None = None,
+    ):
+        self.mode = mode
+        self.batch = batch
+        self.workflow_params = workflow_params or WorkflowParams()
+        self.seed = seed
+        self.app_name = app_name
+        self.channel_name = channel_name
+        self.extra = dict(extra or {})
+        self._mesh = None
+        self._mesh_shape = mesh_shape
+        self._mesh_axes = mesh_axes
+
+    # -- devices -----------------------------------------------------------
+    @property
+    def mesh(self):
+        """Lazily-built jax Mesh (the WorkflowContext.apply analog —
+        constructing it is what 'new SparkContext' is to the reference)."""
+        if self._mesh is None:
+            from ..parallel.mesh import make_mesh
+
+            self._mesh = make_mesh(self._mesh_shape, self._mesh_axes)
+        return self._mesh
+
+    def rng(self, salt: int = 0):
+        import jax
+
+        return jax.random.PRNGKey(self.seed + salt)
+
+    # -- event store access (PEventStore binding) ---------------------------
+    def event_store(self):
+        from ..store import EventStore
+
+        return EventStore(default_app_name=self.app_name,
+                          default_channel_name=self.channel_name)
+
+    def __repr__(self) -> str:
+        return f"Context(mode={self.mode!r}, batch={self.batch!r}, seed={self.seed})"

@@ -1,0 +1,287 @@
+"""CoreWorkflow: orchestrate one training or evaluation run.
+
+Analog of reference ``CoreWorkflow`` (core/src/main/scala/io/prediction/
+workflow/CoreWorkflow.scala:42-150) + the engine-factory resolution part of
+``CreateWorkflow``/``WorkflowUtils`` (workflow/CreateWorkflow.scala:141-277,
+WorkflowUtils.scala:60-127): write the instance record (INIT), run the
+engine, persist models, flip status to COMPLETED/EVALCOMPLETED.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import json
+import logging
+import traceback
+from datetime import datetime, timezone
+from typing import Any, Sequence
+
+from ..controller.components import PersistentModel
+from ..controller.engine import Engine, EngineFactory, TrainResult
+from ..controller.evaluation import Evaluation, MetricEvaluator, MetricEvaluatorResult
+from ..controller.params import EngineParams, params_to_json
+from ..storage import EngineInstance, EvaluationInstance, Model, Storage
+from .context import Context, WorkflowParams
+from .serialization import (
+    PersistentModelManifest,
+    RetrainMarker,
+    deserialize_models,
+    serialize_models,
+)
+
+log = logging.getLogger("predictionio_tpu.workflow")
+
+__all__ = [
+    "resolve_attr", "resolve_engine_factory", "run_train", "run_evaluation",
+    "prepare_deploy",
+]
+
+
+def resolve_attr(path: str) -> Any:
+    """'pkg.module.Attr' or 'pkg.module:Attr' -> attribute. The analog of
+    WorkflowUtils.getEngine's object/class reflection (WorkflowUtils.scala:
+    60-99) with explicit module paths instead of classpath scanning."""
+    if ":" in path:
+        mod_name, attr = path.split(":", 1)
+    else:
+        mod_name, _, attr = path.rpartition(".")
+    if not mod_name:
+        raise ValueError(f"cannot resolve {path!r}: need 'module.Attr'")
+    module = importlib.import_module(mod_name)
+    obj = module
+    for part in attr.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def resolve_engine_factory(path: str) -> Engine:
+    """Resolve an engineFactory string to an Engine instance. Accepts: an
+    EngineFactory subclass, an instance, a function returning an Engine,
+    or an Engine object."""
+    obj = resolve_attr(path)
+    if isinstance(obj, Engine):
+        return obj
+    candidates = []
+    apply = getattr(obj, "apply", None)
+    if apply is not None:
+        candidates.append(apply)  # EngineFactory class w/ static apply, or instance
+        if isinstance(obj, type):
+            candidates.append(lambda: obj().apply())
+    if callable(obj):
+        candidates.append(obj)
+    for make in candidates:
+        try:
+            result = make()
+        except TypeError:
+            continue
+        if isinstance(result, Engine):
+            return result
+    raise TypeError(f"{path!r} did not yield an Engine (got {obj!r})")
+
+
+def _now() -> datetime:
+    return datetime.now(timezone.utc)
+
+
+def _params_field(pair: tuple[str, Any]) -> str:
+    name, params = pair
+    return json.dumps({"name": name, "params": json.loads(params_to_json(params))})
+
+
+def _algo_params_field(pairs: Sequence[tuple[str, Any]]) -> str:
+    return json.dumps(
+        [{"name": n, "params": json.loads(params_to_json(p))} for n, p in pairs]
+    )
+
+
+def _persistable(result: TrainResult, instance_id: str) -> list[Any]:
+    """Apply the three persistence paths per algorithm
+    (Engine.makeSerializableModels, Engine.scala:260-278)."""
+    out = []
+    for algo, model, name in zip(result.algorithms, result.models, result.algorithm_names):
+        if isinstance(model, PersistentModel):
+            saved = model.save(instance_id, algo.params)
+            if saved:
+                out.append(
+                    PersistentModelManifest(
+                        class_name=type(model).__name__, module=type(model).__module__
+                    )
+                )
+            else:
+                out.append(RetrainMarker(algorithm_class=type(algo).__name__))
+        elif algo.persist_model:
+            out.append(model)
+        else:
+            out.append(RetrainMarker(algorithm_class=type(algo).__name__))
+    return out
+
+
+def run_train(
+    engine: Engine,
+    engine_params: EngineParams,
+    ctx: Context | None = None,
+    *,
+    engine_id: str = "default",
+    engine_version: str = "1",
+    engine_variant: str = "default",
+    engine_factory: str = "",
+    batch: str = "",
+    env: dict | None = None,
+) -> str:
+    """Train and persist; returns the engine instance id
+    (CoreWorkflow.runTrain, CoreWorkflow.scala:42-94)."""
+    ctx = ctx or Context(mode="Train", batch=batch)
+    meta = Storage.get_metadata()
+    instance = EngineInstance(
+        status="INIT",
+        start_time=_now(),
+        engine_id=engine_id,
+        engine_version=engine_version,
+        engine_variant=engine_variant,
+        engine_factory=engine_factory,
+        batch=batch,
+        env=env or {},
+        data_source_params=_params_field(engine_params.data_source_params),
+        preparator_params=_params_field(engine_params.preparator_params),
+        algorithms_params=_algo_params_field(engine_params.algorithm_params_list),
+        serving_params=_params_field(engine_params.serving_params),
+    )
+    instance_id = meta.engine_instance_insert(instance)
+    instance = dataclasses.replace(instance, id=instance_id)
+    log.info("EngineInstance %s created; training starts", instance_id)
+    try:
+        result = engine.train(ctx, engine_params)
+        models = _persistable(result, instance_id)
+        blob = serialize_models(models)
+        Storage.get_models().insert(Model(id=instance_id, models=blob))
+        meta.engine_instance_update(
+            dataclasses.replace(instance, status="COMPLETED", end_time=_now())
+        )
+        log.info("Training completed: instance %s (%d model(s), %d bytes)",
+                 instance_id, len(models), len(blob))
+    except Exception:
+        meta.engine_instance_update(
+            dataclasses.replace(instance, status="ABORTED", end_time=_now())
+        )
+        log.error("Training aborted:\n%s", traceback.format_exc())
+        raise
+    return instance_id
+
+
+def run_evaluation(
+    evaluation: Evaluation,
+    engine_params_list: Sequence[EngineParams],
+    ctx: Context | None = None,
+    *,
+    evaluation_class: str = "",
+    generator_class: str = "",
+    batch: str = "",
+    best_json_path: str | None = None,
+) -> tuple[str, MetricEvaluatorResult]:
+    """Batch-eval a params grid and rank it (CoreWorkflow.runEvaluation,
+    CoreWorkflow.scala:96-150 + EvaluationWorkflow.scala:29-41)."""
+    ctx = ctx or Context(mode="Evaluation", batch=batch)
+    meta = Storage.get_metadata()
+    instance = EvaluationInstance(
+        status="INIT",
+        start_time=_now(),
+        evaluation_class=evaluation_class,
+        engine_params_generator_class=generator_class,
+        batch=batch,
+    )
+    instance_id = meta.evaluation_instance_insert(instance)
+    instance = dataclasses.replace(instance, id=instance_id)
+    try:
+        engine = evaluation.engine
+        results = engine.batch_eval(ctx, engine_params_list)
+        metrics = evaluation.all_metrics
+        evaluator = MetricEvaluator(
+            metric=metrics[0], other_metrics=metrics[1:],
+            best_json_path=best_json_path,
+        )
+        result = evaluator.evaluate(ctx, results)
+        meta.evaluation_instance_update(
+            dataclasses.replace(
+                instance,
+                status="EVALCOMPLETED",
+                end_time=_now(),
+                evaluator_results=result.to_one_liner(),
+                evaluator_results_html=result.to_html(),
+                evaluator_results_json=result.to_json(),
+            )
+        )
+        log.info("Evaluation completed: instance %s", instance_id)
+        return instance_id, result
+    except Exception:
+        meta.evaluation_instance_update(
+            dataclasses.replace(instance, status="ABORTED", end_time=_now())
+        )
+        raise
+
+
+def prepare_deploy(
+    engine: Engine, instance: EngineInstance, ctx: Context | None = None
+) -> TrainResult:
+    """Rehydrate models for serving (Engine.prepareDeploy, Engine.scala:
+    174-243): deserialize stored models; PersistentModelManifest -> call
+    the class's ``load``; RetrainMarker -> retrain from the stored params."""
+    ctx = ctx or Context(mode="Serving")
+    engine_params = engine_params_from_instance(engine, instance)
+    names, algos = engine.make_algorithms(engine_params)
+    serving = engine.make_serving(engine_params)
+
+    blob = Storage.get_models().get(instance.id)
+    if blob is None:
+        raise RuntimeError(f"no model blob for engine instance {instance.id}")
+    stored = deserialize_models(blob.models)
+
+    models: list[Any] = []
+    needs_retrain = any(isinstance(m, RetrainMarker) for m in stored)
+    retrained: TrainResult | None = None
+    if needs_retrain:
+        log.info("Some models are not serializable; retraining at deploy "
+                 "(reference Engine.scala:186-208 path)")
+        retrained = engine.train(ctx, engine_params)
+    for i, (m, algo) in enumerate(zip(stored, algos)):
+        if isinstance(m, PersistentModelManifest):
+            cls = getattr(importlib.import_module(m.module), m.class_name)
+            models.append(cls.load(instance.id, algo.params, ctx))
+        elif isinstance(m, RetrainMarker):
+            assert retrained is not None
+            models.append(retrained.models[i])
+        else:
+            models.append(m)
+    return TrainResult(models=models, algorithms=algos, serving=serving,
+                       algorithm_names=names)
+
+
+def engine_params_from_instance(engine: Engine, instance: EngineInstance) -> EngineParams:
+    """Rebuild EngineParams from the instance's stored JSON fields
+    (Engine.engineInstanceToEngineParams, Engine.scala:387-440)."""
+    def one(js: str, classes) -> tuple[str, Any]:
+        d = json.loads(js) if js else {"name": "", "params": {}}
+        name = d.get("name", "")
+        cls = engine._pick(classes, name, "component")
+        pcls = getattr(cls, "params_class", None)
+        raw = d.get("params", {})
+        from ..controller.params import parse_params
+
+        return (name, parse_params(pcls, raw) if pcls is not None else (raw or None))
+
+    algo_pairs = []
+    for d in json.loads(instance.algorithms_params or "[]"):
+        name = d.get("name", "")
+        cls = engine._pick(engine.algorithm_classes, name, "algorithm")
+        pcls = getattr(cls, "params_class", None)
+        raw = d.get("params", {})
+        from ..controller.params import parse_params
+
+        algo_pairs.append((name, parse_params(pcls, raw) if pcls is not None else (raw or None)))
+
+    return EngineParams(
+        data_source_params=one(instance.data_source_params, engine.data_source_classes),
+        preparator_params=one(instance.preparator_params, engine.preparator_classes),
+        algorithm_params_list=tuple(algo_pairs),
+        serving_params=one(instance.serving_params, engine.serving_classes),
+    )

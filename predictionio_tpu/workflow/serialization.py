@@ -1,0 +1,66 @@
+"""Model (de)serialization for the model store.
+
+The reference Kryo-serializes the trained model list into MODELDATA
+(reference: core/src/main/scala/io/prediction/workflow/CoreWorkflow.scala:
+69-74 and CreateServer.scala:61-75 KryoInstantiator). Here models are
+pytrees; jax Arrays are pulled to host numpy before pickling so blobs are
+device-independent, and algorithms whose ``persist_model`` is False are
+stored as a ``PersistentModelManifest`` (className marker) or a retrain
+marker — the reference's three persistence paths (Engine.makeSerializable
+Models, Engine.scala:260-278; PersistentModelManifest.scala).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import pickle
+from typing import Any, Sequence
+
+__all__ = [
+    "PersistentModelManifest", "RetrainMarker", "serialize_models",
+    "deserialize_models",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class PersistentModelManifest:
+    """Marker stored in place of a custom-persisted model
+    (reference: workflow/PersistentModelManifest.scala)."""
+
+    class_name: str
+    module: str
+
+
+@dataclasses.dataclass(frozen=True)
+class RetrainMarker:
+    """Marker for non-persistable models: retrain at deploy
+    (reference: Engine.prepareDeploy, Engine.scala:186-208)."""
+
+    algorithm_class: str
+
+
+def _to_host(tree: Any) -> Any:
+    """Pull any jax Arrays in a pytree down to numpy for pickling."""
+    try:
+        import jax
+        import numpy as np
+    except ImportError:  # storage-only installs
+        return tree
+
+    def conv(x):
+        if isinstance(x, jax.Array):
+            return np.asarray(x)
+        return x
+
+    return jax.tree_util.tree_map(conv, tree)
+
+
+def serialize_models(models: Sequence[Any]) -> bytes:
+    buf = io.BytesIO()
+    pickle.dump([_to_host(m) for m in models], buf, protocol=pickle.HIGHEST_PROTOCOL)
+    return buf.getvalue()
+
+
+def deserialize_models(blob: bytes) -> list[Any]:
+    return pickle.loads(blob)

@@ -1,0 +1,116 @@
+"""Engine train/eval wiring — mirrors reference EngineTest
+(core/src/test/.../controller/EngineTest.scala: EngineSuite :18,
+EngineTrainSuite :279, EngineEvalSuite :416)."""
+
+import pytest
+
+from predictionio_tpu.controller import Engine, EngineParams
+from predictionio_tpu.controller.engine import (
+    StopAfterPrepareInterruption,
+    StopAfterReadInterruption,
+)
+from predictionio_tpu.testing.sample_engine import (
+    SampleAlgoParams,
+    SampleDataSourceParams,
+    SamplePrediction,
+    make_sample_engine,
+    sample_engine_params,
+)
+from predictionio_tpu.workflow import Context, WorkflowParams
+
+
+def ctx(**kw):
+    return Context(workflow_params=WorkflowParams(**kw))
+
+
+def test_train_single_algo():
+    engine = make_sample_engine()
+    result = engine.train(ctx(), sample_engine_params(ds_id=3))
+    assert len(result.models) == 1
+    m = result.models[0]
+    assert (m.ds_id, m.prep_id, m.algo_id) == (3, 1, 1)
+
+
+def test_train_multiple_algos_ordered():
+    engine = make_sample_engine()
+    ep = sample_engine_params(
+        algos=(
+            ("sample", SampleAlgoParams(id=10)),
+            ("sample", SampleAlgoParams(id=20)),
+            ("unser", SampleAlgoParams(id=30)),
+        )
+    )
+    result = engine.train(ctx(), ep)
+    assert [m.algo_id for m in result.models] == [10, 20, 30]
+    assert result.algorithm_names == ["sample", "sample", "unser"]
+
+
+def test_sanity_check_gate():
+    engine = make_sample_engine()
+    bad = sample_engine_params(error=True)
+    with pytest.raises(ValueError, match="sanity check"):
+        engine.train(ctx(), bad)
+    # skip flag bypasses (reference WorkflowParams.skipSanityCheck)
+    engine.train(ctx(skip_sanity_check=True), bad)
+
+
+def test_stop_after_gates():
+    engine = make_sample_engine()
+    with pytest.raises(StopAfterReadInterruption):
+        engine.train(ctx(stop_after_read=True), sample_engine_params())
+    with pytest.raises(StopAfterPrepareInterruption):
+        engine.train(ctx(stop_after_prepare=True), sample_engine_params())
+
+
+def test_eval_join_correctness():
+    """Predictions joined to the right queries/actuals across 2 algos x 2
+    folds (reference EngineEvalSuite join assertions)."""
+    engine = make_sample_engine()
+    ep = EngineParams(
+        data_source_params=("", SampleDataSourceParams(id=5, n_folds=2, n_queries=3)),
+        algorithm_params_list=(
+            ("sample", SampleAlgoParams(id=1, multiplier=2)),
+            ("sample", SampleAlgoParams(id=2, multiplier=10)),
+        ),
+    )
+    folds = engine.eval(ctx(), ep)
+    assert len(folds) == 2
+    for fold_idx, fold in enumerate(folds):
+        assert fold.eval_info == {"fold": fold_idx}
+        assert len(fold.qpa) == 3
+        for q, p, a in fold.qpa:
+            assert isinstance(p, SamplePrediction)
+            assert p.algo_ids == (1, 2)  # both algos served, in order
+            assert p.value == q.q * 2 + q.q * 10  # joined to the right query
+            assert a.a == q.q  # actual aligned with query
+
+
+def test_engine_params_from_json():
+    engine = make_sample_engine()
+    variant = {
+        "id": "default",
+        "engineFactory": "predictionio_tpu.testing.sample_engine.SampleEngine",
+        "datasource": {"params": {"id": 9}},
+        "algorithms": [
+            {"name": "sample", "params": {"id": 4, "multiplier": 3}},
+        ],
+    }
+    ep = engine.engine_params_from_json(variant)
+    assert ep.data_source_params[1].id == 9
+    assert ep.algorithm_params_list[0][1].multiplier == 3
+    result = engine.train(ctx(), ep)
+    assert result.models[0].ds_id == 9
+
+
+def test_engine_params_from_json_rejects_typos():
+    engine = make_sample_engine()
+    variant = {"datasource": {"params": {"idd": 9}}, "algorithms": []}
+    with pytest.raises(ValueError, match="unknown parameter"):
+        engine.engine_params_from_json(variant)
+
+
+def test_unknown_component_name():
+    engine = make_sample_engine()
+    ep = sample_engine_params(algos=(("nope", SampleAlgoParams()),))
+    with pytest.raises(KeyError, match="nope"):
+        engine.train(ctx(), ep)

@@ -1,0 +1,155 @@
+"""CoreWorkflow train/eval runs + deploy rehydration — mirrors reference
+EngineWorkflowTest / EvaluationWorkflowTest and the prepareDeploy branches
+of EngineTest (core/src/test/.../workflow/, controller/EngineTest.scala)."""
+
+import pytest
+
+from predictionio_tpu.controller import (
+    AverageMetric,
+    EngineParams,
+    Evaluation,
+    FastEvalEngine,
+)
+from predictionio_tpu.storage import Storage
+from predictionio_tpu.testing.sample_engine import (
+    SampleAlgoParams,
+    SampleDataSourceParams,
+    make_sample_engine,
+    sample_engine_params,
+)
+from predictionio_tpu.workflow import (
+    prepare_deploy,
+    resolve_engine_factory,
+    run_evaluation,
+    run_train,
+)
+
+
+def test_run_train_lifecycle():
+    engine = make_sample_engine()
+    iid = run_train(engine, sample_engine_params(ds_id=2), engine_factory="x.y.Z")
+    meta = Storage.get_metadata()
+    inst = meta.engine_instance_get(iid)
+    assert inst.status == "COMPLETED"
+    assert inst.engine_factory == "x.y.Z"
+    blob = Storage.get_models().get(iid)
+    assert blob is not None and len(blob.models) > 0
+    # latest completed lookup finds it
+    latest = meta.engine_instance_get_latest_completed("default", "1", "default")
+    assert latest.id == iid
+
+
+def test_run_train_abort_on_error():
+    engine = make_sample_engine()
+    with pytest.raises(ValueError):
+        run_train(engine, sample_engine_params(error=True))
+    insts = Storage.get_metadata().engine_instance_get_all()
+    assert len(insts) == 1 and insts[0].status == "ABORTED"
+
+
+def test_prepare_deploy_roundtrip():
+    engine = make_sample_engine()
+    iid = run_train(engine, sample_engine_params(ds_id=4))
+    inst = Storage.get_metadata().engine_instance_get(iid)
+    result = prepare_deploy(engine, inst)
+    assert result.models[0].ds_id == 4
+    # serve a query through the rehydrated pipeline
+    from predictionio_tpu.testing.sample_engine import SampleQuery
+
+    preds = [
+        a.predict(m, SampleQuery(q=3))
+        for a, m in zip(result.algorithms, result.models)
+    ]
+    assert result.serving.serve(SampleQuery(q=3), preds).value == 3
+
+
+def test_prepare_deploy_retrains_unserializable():
+    """persist_model=False -> RetrainMarker -> retrained at deploy
+    (reference Engine.scala:186-208)."""
+    engine = make_sample_engine()
+    ep = sample_engine_params(algos=(("unser", SampleAlgoParams(id=5)),))
+    iid = run_train(engine, ep)
+    from predictionio_tpu.workflow.serialization import RetrainMarker, deserialize_models
+
+    blob = Storage.get_models().get(iid)
+    stored = deserialize_models(blob.models)
+    assert isinstance(stored[0], RetrainMarker)
+    inst = Storage.get_metadata().engine_instance_get(iid)
+    result = prepare_deploy(engine, inst)
+    assert result.models[0].algo_id == 5  # retrained fresh
+
+
+def test_resolve_engine_factory():
+    engine = resolve_engine_factory(
+        "predictionio_tpu.testing.sample_engine.SampleEngine"
+    )
+    assert engine.algorithm_classes
+    fn = resolve_engine_factory(
+        "predictionio_tpu.testing.sample_engine:make_sample_engine"
+    )
+    assert fn.algorithm_classes
+
+
+class _ValueMetric(AverageMetric):
+    def calculate_qpa(self, q, p, a) -> float:
+        return float(p.value)
+
+
+def test_run_evaluation_leaderboard(tmp_path):
+    engine = make_sample_engine()
+
+    class Eval(Evaluation):
+        pass
+
+    Eval.engine = engine
+    Eval.metric = _ValueMetric()
+
+    grid = [
+        EngineParams(
+            data_source_params=("", SampleDataSourceParams(id=1, n_folds=2)),
+            algorithm_params_list=(("sample", SampleAlgoParams(id=1, multiplier=m)),),
+        )
+        for m in (1, 5, 3)
+    ]
+    best_json = tmp_path / "best.json"
+    iid, result = run_evaluation(Eval(), grid, best_json_path=str(best_json))
+    assert result.best_idx == 1  # multiplier=5 maximizes mean prediction value
+    assert best_json.exists()
+    inst = Storage.get_metadata().evaluation_instance_get(iid)
+    assert inst.status == "EVALCOMPLETED"
+    assert inst.evaluator_results_json
+    assert "leaderboard" in result.pretty_print()
+
+
+def test_fast_eval_prefix_memoization():
+    """Shared prefixes compute once — mirrors FastEvalEngineTest reuse-count
+    assertions (core/src/test/.../controller/FastEvalEngineTest.scala:1-181)."""
+    engine = FastEvalEngine(
+        data_source_classes=make_sample_engine().data_source_classes,
+        preparator_classes=make_sample_engine().preparator_classes,
+        algorithm_classes=make_sample_engine().algorithm_classes,
+        serving_classes=make_sample_engine().serving_classes,
+    )
+    ds = SampleDataSourceParams(id=1, n_folds=1)
+    grid = [
+        EngineParams(
+            data_source_params=("", ds),
+            algorithm_params_list=(("sample", SampleAlgoParams(id=1, multiplier=m)),),
+        )
+        for m in (1, 2, 3)
+    ]
+
+    from predictionio_tpu.workflow import Context
+
+    engine.batch_eval(Context(), grid)
+    # datasource+preparator prefix shared by all 3 variants: hit 2x each
+    assert engine.hit_counts["datasource"] == 0  # accessed via _prepared only
+    assert engine.hit_counts["preparator"] == 2
+    assert engine.hit_counts["algorithms"] == 0  # all algo params differ
+
+    # same algo params again: algorithms prefix now hits
+    engine.batch_eval(Context(), grid[:1])
+    assert engine.hit_counts["algorithms"] == 1
+
+    with pytest.raises(RuntimeError):
+        engine.train(Context(), grid[0])
