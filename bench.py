@@ -1,0 +1,161 @@
+"""Benchmark: blocked WALS (ALS) training throughput, MovieLens-20M scale.
+
+The north-star metric from BASELINE.json: ALS iters/sec/chip on ML-20M
+(138,493 users x 26,744 items x 20M ratings), rank 64. The reference
+publishes no numbers (BASELINE.md), so the baseline is measured here:
+the same solver, same config, on the host CPU (the reference's substrate
+is CPU Spark) over a 2M-rating subsample, scaled linearly to 20M.
+
+Prints exactly ONE JSON line to stdout:
+  {"metric": ..., "value": N, "unit": "iters/sec/chip", "vs_baseline": N}
+Diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+NU, NI, N_RATINGS = 138_493, 26_744, 20_000_000
+RANK = 64
+TIMED_ITERS = 10
+CPU_SUBSAMPLE = 2_000_000
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def synth_ml20m(n: int, seed: int = 0):
+    """ML-20M-shaped synthetic ratings: zipf item popularity truncated at
+    ML-20M's real max item degree (~67k ratings for the top movie), uniform
+    user activity, ratings in [0.5, 5]."""
+    rng = np.random.default_rng(seed)
+    ranks = np.arange(1, NI + 1, dtype=np.float64)
+    pop = 1.0 / ranks**0.9
+    pop = np.minimum(pop / pop.sum(), 67_000 / N_RATINGS)
+    pop /= pop.sum()
+    items = rng.choice(NI, size=n, p=pop).astype(np.int32)
+    users = rng.integers(0, NU, n).astype(np.int32)
+    vals = (np.round(rng.random(n) * 9 + 1) / 2).astype(np.float32)
+    return users, items, vals
+
+
+def run_bench(n_ratings: int, iters: int, device_kind: str) -> dict:
+    import jax
+
+    from predictionio_tpu.models.als import _put_buckets, make_train_step
+    from predictionio_tpu.ops.neighbors import build_degree_buckets
+    from predictionio_tpu.parallel.mesh import make_mesh
+
+    t0 = time.time()
+    users, items, vals = synth_ml20m(n_ratings)
+    log(f"[{device_kind}] data gen ({n_ratings} ratings): {time.time()-t0:.1f}s")
+
+    t0 = time.time()
+    u_buckets = build_degree_buckets(users, items, vals, NU)
+    i_buckets = build_degree_buckets(items, users, vals, NI)
+    dropped = sum(b.blocks.dropped for b in u_buckets + i_buckets)
+    log(
+        f"[{device_kind}] layout: {time.time()-t0:.1f}s; "
+        f"user tiers {[b.blocks.ids.shape for b in u_buckets]}, "
+        f"item tiers {[b.blocks.ids.shape for b in i_buckets]}, dropped {dropped}"
+    )
+
+    mesh = make_mesh()
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    t0 = time.time()
+    u_bk = _put_buckets(u_buckets, mesh)
+    i_bk = _put_buckets(i_buckets, mesh)
+    rng = np.random.default_rng(1)
+    v = jax.device_put(
+        np.abs(rng.normal(size=(NI, RANK))).astype(np.float32) / np.sqrt(RANK),
+        NamedSharding(mesh, P()),
+    )
+    log(f"[{device_kind}] device_put: {time.time()-t0:.1f}s on {jax.devices()[0].platform}")
+
+    step = make_train_step(mesh, rank=RANK, lambda_=0.1, nu=NU, ni=NI)
+
+    def pull(arr) -> np.ndarray:
+        # On remote-execution platforms block_until_ready can return before
+        # queued work completes; a device->host pull is the only reliable
+        # fence, so every timing ends with one.
+        return np.asarray(arr[:8])
+
+    t0 = time.time()
+    u, v = step(u_bk, i_bk, v)
+    first = pull(u)
+    log(f"[{device_kind}] compile+first iter: {time.time()-t0:.1f}s")
+    t0 = time.time()
+    pull_cost = 0.0
+    for _ in range(3):
+        s = time.time()
+        pull(u)
+        pull_cost = max(pull_cost, time.time() - s)
+    log(f"[{device_kind}] pull fence cost: {pull_cost*1e3:.1f}ms")
+
+    t0 = time.time()
+    for _ in range(iters):
+        u, v = step(u_bk, i_bk, v)
+    final = pull(u)
+    dt = max(time.time() - t0 - pull_cost, 1e-9)
+    assert np.isfinite(final).all()
+    log(f"[{device_kind}] {iters} iters in {dt:.2f}s -> {iters/dt:.3f} iters/sec")
+    return {"iters_per_sec": iters / dt, "n_ratings": n_ratings}
+
+
+def cpu_floor() -> float:
+    """Measure the CPU floor in a subprocess (fresh jax platform), scaled
+    linearly from the subsample to full size."""
+    code = (
+        "import os\n"
+        "os.environ['JAX_PLATFORMS']='cpu'\n"
+        "import sys, json\n"
+        "sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)) if '__file__' in dir() else '.')\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import bench\n"
+        "r = bench.run_bench(bench.CPU_SUBSAMPLE, 2, 'cpu-floor')\n"
+        "print('FLOOR ' + json.dumps(r))\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=os.path.dirname(os.path.abspath(__file__)))
+    out = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True, text=True, env=env, timeout=1800,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    log(out.stderr[-2000:])
+    for line in out.stdout.splitlines():
+        if line.startswith("FLOOR "):
+            r = json.loads(line[6:])
+            # per-rating throughput scales ~linearly; convert to full-size iters/sec
+            return r["iters_per_sec"] * (r["n_ratings"] / N_RATINGS)
+    raise RuntimeError(f"cpu floor failed: {out.stdout[-500:]} {out.stderr[-500:]}")
+
+
+def main() -> None:
+    result = run_bench(N_RATINGS, TIMED_ITERS, "chip")
+    value = result["iters_per_sec"]
+    try:
+        floor = cpu_floor()
+        log(f"cpu floor (scaled to 20M): {floor:.4f} iters/sec")
+        vs = value / floor
+    except Exception as e:  # noqa: BLE001 — floor is informative, not load-bearing
+        log(f"cpu floor unavailable: {e}")
+        vs = 0.0
+    print(json.dumps({
+        "metric": "als_train_iters_per_sec_ml20m_rank64",
+        "value": round(value, 3),
+        "unit": "iters/sec/chip",
+        "vs_baseline": round(vs, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -45,10 +45,39 @@ def parse_params(cls: Type[P], data: Mapping[str, Any] | None) -> P:
             f"unknown parameter(s) {sorted(unknown)} for {cls.__name__}; "
             f"expected a subset of {sorted(names)}"
         )
+    _check_field_types(cls, data)
     try:
         return cls(**data)  # type: ignore[call-arg]
     except TypeError as e:
         raise ValueError(f"cannot construct {cls.__name__} from {data}: {e}") from e
+
+
+_SIMPLE_TYPES = {"int": int, "float": float, "str": str, "bool": bool}
+
+
+def _check_field_types(cls, data: dict) -> None:
+    """Validate/coerce JSON values against simple field annotations so a
+    wrong-typed engine.json or query gives a clear 400, not a deep
+    TypeError. Only str/int/float/bool annotations are enforced; anything
+    else passes through."""
+    for f in dataclasses.fields(cls):
+        if f.name not in data:
+            continue
+        expected = _SIMPLE_TYPES.get(
+            f.type if isinstance(f.type, str) else getattr(f.type, "__name__", "")
+        )
+        if expected is None:
+            continue
+        v = data[f.name]
+        if expected is float and isinstance(v, int) and not isinstance(v, bool):
+            data[f.name] = float(v)
+        elif expected is int and isinstance(v, bool):
+            raise ValueError(f"field {f.name!r} must be {expected.__name__}, got bool")
+        elif not isinstance(v, expected):
+            raise ValueError(
+                f"field {f.name!r} must be {expected.__name__}, "
+                f"got {type(v).__name__} ({v!r})"
+            )
 
 
 def params_to_json(p: Any) -> str:

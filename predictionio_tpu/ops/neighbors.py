@@ -1,0 +1,192 @@
+"""Host-side layout: COO ratings -> fixed-shape padded neighbor blocks.
+
+The TPU ALS solver needs, for every user (resp. item), the list of rated
+items (resp. rating users) as FIXED-SHAPE arrays — XLA cannot tile
+variable-degree lists onto the MXU. This module builds that layout:
+
+  ``NeighborBlocks``: ids [NB, B, D], vals [NB, B, D], mask [NB, B, D]
+
+where B is the per-block row count (sharded over the mesh's data axis) and
+D the padded max degree (capped; overflow entries are dropped highest-
+degree-first with a deterministic subsample). This is the role MLlib ALS's
+``InLinkBlock/OutLinkBlock`` shuffle layout plays in the reference's
+training path (examples/.../ALSAlgorithm.scala -> org.apache.spark.mllib.
+recommendation.ALS), re-thought for static shapes instead of shuffles:
+layout is computed once on host with numpy sorts, then stays resident.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import numpy as np
+
+__all__ = [
+    "DegreeBucket", "NeighborBlocks", "build_degree_buckets",
+    "build_neighbor_blocks",
+]
+
+
+@dataclasses.dataclass
+class NeighborBlocks:
+    """Padded per-row neighbor lists, reshaped into blocks."""
+
+    ids: np.ndarray  # int32 [NB, B, D] neighbor indices (0 where padded)
+    vals: np.ndarray  # float32 [NB, B, D] ratings/confidences (0 where padded)
+    mask: np.ndarray  # float32 [NB, B, D] 1.0 = real entry
+    num_rows: int  # true number of rows (before padding to NB*B)
+    max_degree: int  # D after capping
+    dropped: int  # entries dropped by the degree cap
+
+    @property
+    def padded_rows(self) -> int:
+        return self.ids.shape[0] * self.ids.shape[1]
+
+
+@dataclasses.dataclass
+class DegreeBucket:
+    """One degree tier of the bucketed layout: the rows whose degree fits
+    this tier's D, plus the scatter indices mapping solved rows back into
+    the factor matrix (out-of-range index = padding row, dropped by the
+    scatter)."""
+
+    blocks: NeighborBlocks
+    row_ids: np.ndarray  # int32 [NB*B]; == num_total_rows for padding
+
+
+def build_degree_buckets(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    num_rows: int,
+    *,
+    tiers: tuple[int, ...] = (128, 1024, 8192, 65536),
+    gather_budget: int = 2_000_000,
+    seed: int = 0,
+) -> list[DegreeBucket]:
+    """ALX-style density-based layout: rows are grouped by degree tier so
+    no tier wastes padding on light rows and heavy rows are not truncated
+    (only degrees beyond the last tier are subsampled). Per tier, the
+    block row count is sized so one block's gathered factors stay within
+    ``gather_budget`` elements (B * D <= budget) — bounding peak memory
+    regardless of degree skew."""
+    counts = np.bincount(rows, minlength=num_rows) if len(rows) else np.zeros(num_rows, np.int64)
+    buckets: list[DegreeBucket] = []
+    prev = 0
+    for t_idx, tier_d in enumerate(tiers):
+        last = t_idx == len(tiers) - 1
+        sel = (counts > prev) & ((counts <= tier_d) | last)
+        if t_idx == 0:
+            sel |= counts == 0  # degree-0 rows ride the smallest tier
+        row_idx = np.nonzero(sel)[0]
+        prev = tier_d
+        if len(row_idx) == 0:
+            continue
+        # remap selected rows to 0..len-1 for block building
+        remap = np.full(num_rows, -1, np.int64)
+        remap[row_idx] = np.arange(len(row_idx))
+        in_sel = remap[rows] >= 0 if len(rows) else np.zeros(0, bool)
+        b = build_neighbor_blocks(
+            remap[rows[in_sel]].astype(np.int64),
+            cols[in_sel],
+            vals[in_sel],
+            len(row_idx),
+            block_rows=_block_rows_for(tier_d, gather_budget),
+            degree_cap=tier_d,
+            seed=seed,
+        )
+        ids_pad = np.full(b.padded_rows, num_rows, np.int32)  # padding sentinel
+        ids_pad[: len(row_idx)] = row_idx.astype(np.int32)
+        buckets.append(DegreeBucket(blocks=b, row_ids=ids_pad))
+    return buckets
+
+
+def _block_rows_for(tier_d: int, gather_budget: int) -> int:
+    b = max(8, gather_budget // max(tier_d, 8))
+    return min(8192, ((b + 7) // 8) * 8)
+
+
+def build_neighbor_blocks(
+    rows: np.ndarray,
+    cols: np.ndarray,
+    vals: np.ndarray,
+    num_rows: int,
+    *,
+    block_rows: int = 4096,
+    max_degree: int | None = None,
+    degree_cap: int = 1024,
+    seed: int = 0,
+) -> NeighborBlocks:
+    """Group (rows, cols, vals) COO triples by row into padded blocks.
+
+    - D = min(max observed degree, ``degree_cap``) rounded up to a multiple
+      of 8 (float32 sublane tiling).
+    - Rows with degree > D keep a deterministic random subsample (the
+    	same trade MLlib users make with sampling heavy users).
+    - Rows padded to a multiple of ``block_rows``.
+    """
+    if len(rows) == 0:
+        d = 8
+        nb = max(1, math.ceil(max(num_rows, 1) / block_rows))
+        shape = (nb, block_rows, d)
+        return NeighborBlocks(
+            ids=np.zeros(shape, np.int32),
+            vals=np.zeros(shape, np.float32),
+            mask=np.zeros(shape, np.float32),
+            num_rows=num_rows,
+            max_degree=d,
+            dropped=0,
+        )
+
+    order = np.argsort(rows, kind="stable")
+    r_sorted = rows[order]
+    c_sorted = cols[order].astype(np.int32)
+    v_sorted = vals[order].astype(np.float32)
+
+    counts = np.bincount(r_sorted, minlength=num_rows)
+    observed_max = int(counts.max())
+    d = observed_max if max_degree is None else min(max_degree, observed_max)
+    d = min(d, degree_cap)
+    d = max(8, ((d + 7) // 8) * 8)
+
+    # position of each entry within its row
+    starts = np.zeros(num_rows + 1, dtype=np.int64)
+    np.cumsum(counts, out=starts[1:])
+    pos_in_row = np.arange(len(r_sorted)) - starts[r_sorted]
+
+    dropped = 0
+    overflow = counts > d
+    if overflow.any():
+        # deterministic per-row subsample: random permutation rank, keep < d
+        rng = np.random.default_rng(seed)
+        rand_key = rng.random(len(r_sorted))
+        # rank entries within each row by random key
+        order2 = np.lexsort((rand_key, r_sorted))
+        rank = np.empty(len(r_sorted), dtype=np.int64)
+        rank[order2] = np.arange(len(r_sorted)) - starts[r_sorted[order2]]
+        keep = rank < d
+        dropped = int((~keep).sum())
+        r_sorted, c_sorted, v_sorted = r_sorted[keep], c_sorted[keep], v_sorted[keep]
+        counts = np.bincount(r_sorted, minlength=num_rows)
+        starts = np.zeros(num_rows + 1, dtype=np.int64)
+        np.cumsum(counts, out=starts[1:])
+        pos_in_row = np.arange(len(r_sorted)) - starts[r_sorted]
+
+    nb = max(1, math.ceil(num_rows / block_rows))
+    padded_rows = nb * block_rows
+    ids = np.zeros((padded_rows, d), np.int32)
+    vv = np.zeros((padded_rows, d), np.float32)
+    mask = np.zeros((padded_rows, d), np.float32)
+    ids[r_sorted, pos_in_row] = c_sorted
+    vv[r_sorted, pos_in_row] = v_sorted
+    mask[r_sorted, pos_in_row] = 1.0
+
+    return NeighborBlocks(
+        ids=ids.reshape(nb, block_rows, d),
+        vals=vv.reshape(nb, block_rows, d),
+        mask=mask.reshape(nb, block_rows, d),
+        num_rows=num_rows,
+        max_degree=d,
+        dropped=dropped,
+    )

@@ -1,0 +1,1 @@
+"""Tools / CLI / ops servers (L6 of the framework)."""

@@ -1,0 +1,563 @@
+"""The ``pio`` console: command-line surface of the framework.
+
+Analog of reference ``Console`` (tools/src/main/scala/io/prediction/tools/
+console/Console.scala:128-1245). Same verb set, no JVM/spark-submit spawning
+— train/eval/deploy run in-process (the compiled XLA programs are the
+"cluster"):
+
+  pio app new|list|show|delete|data-delete|channel-new|channel-delete
+  pio accesskey new|list|delete
+  pio build | unregister
+  pio train [--engine-json engine.json] [...]
+  pio eval <Evaluation> [<EngineParamsGenerator>]
+  pio deploy [--port 8000] [--feedback] [--event-server-url ...]
+  pio undeploy [--port 8000]
+  pio eventserver [--port 7070] [--stats]
+  pio adminserver [--port 7071]
+  pio dashboard [--port 9000]
+  pio import|export --appid N --input|--output FILE
+  pio template list|get
+  pio status | version
+
+Engine directory convention (replacing the reference's sbt build + jar
+manifest): an engine dir holds ``engine.json`` whose ``engineFactory``
+names a Python attribute importable with the engine dir on sys.path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import logging
+import sys
+from pathlib import Path
+
+from .. import __version__
+
+log = logging.getLogger("predictionio_tpu.cli")
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _storage():
+    from ..storage import Storage
+
+    return Storage
+
+
+def _load_variant(engine_dir: Path, engine_json: str) -> dict:
+    path = engine_dir / engine_json
+    if not path.exists():
+        _die(f"{path} not found. Run from an engine directory (or --engine-dir).")
+    with open(path) as f:
+        return json.load(f)
+
+
+def _engine_from_variant(engine_dir: Path, variant: dict):
+    from ..workflow import resolve_engine_factory
+
+    factory = variant.get("engineFactory")
+    if not factory:
+        _die("engine.json has no engineFactory field")
+    sys.path.insert(0, str(engine_dir))
+    try:
+        return resolve_engine_factory(factory)
+    finally:
+        pass  # keep path: deploy/predict needs the module importable
+
+
+def _engine_ids(engine_dir: Path, variant: dict) -> tuple[str, str, str]:
+    engine_id = variant.get("id") or engine_dir.resolve().name
+    version = str(variant.get("version", "1"))
+    variant_id = variant.get("id", "default")
+    return engine_id, version, variant_id
+
+
+def _die(msg: str, code: int = 1):
+    print(f"[ERROR] {msg}", file=sys.stderr)
+    raise SystemExit(code)
+
+
+def _ok(msg: str):
+    print(msg)
+
+
+# ---------------------------------------------------------------------------
+# app / accesskey (console/App.scala:1-499, AccessKey.scala)
+# ---------------------------------------------------------------------------
+
+def cmd_app(args) -> int:
+    meta = _storage().get_metadata()
+    events = _storage().get_events()
+    sub = args.app_command
+    if sub == "new":
+        app = meta.app_insert(args.name, args.description)
+        if app is None:
+            _die(f"App {args.name!r} already exists.")
+        events.init_app(app.id)
+        ak = meta.access_key_insert(app.id, key=args.access_key)
+        if ak is None:
+            _die(f"Access key already exists.")
+        _ok(f"App created: id={app.id} name={app.name}")
+        _ok(f"Access key: {ak.key}")
+    elif sub == "list":
+        for app in meta.app_get_all():
+            keys = meta.access_key_get_by_appid(app.id)
+            _ok(f"  id={app.id:4d}  name={app.name}  accessKeys={len(keys)}")
+    elif sub == "show":
+        app = meta.app_get_by_name(args.name)
+        if app is None:
+            _die(f"App {args.name!r} not found.")
+        _ok(f"App: id={app.id} name={app.name} description={app.description}")
+        for ak in meta.access_key_get_by_appid(app.id):
+            _ok(f"  access key: {ak.key} (events: {list(ak.events) or 'all'})")
+        for ch in meta.channel_get_by_appid(app.id):
+            _ok(f"  channel: id={ch.id} name={ch.name}")
+    elif sub == "delete":
+        app = meta.app_get_by_name(args.name)
+        if app is None:
+            _die(f"App {args.name!r} not found.")
+        for ch in meta.channel_get_by_appid(app.id):
+            events.remove_app(app.id, ch.id)
+            meta.channel_delete(ch.id)
+        for ak in meta.access_key_get_by_appid(app.id):
+            meta.access_key_delete(ak.key)
+        events.remove_app(app.id)
+        meta.app_delete(app.id)
+        _ok(f"App {args.name!r} deleted.")
+    elif sub == "data-delete":
+        app = meta.app_get_by_name(args.name)
+        if app is None:
+            _die(f"App {args.name!r} not found.")
+        if args.channel:
+            chans = {c.name: c for c in meta.channel_get_by_appid(app.id)}
+            if args.channel not in chans:
+                _die(f"Channel {args.channel!r} not found.")
+            ch = chans[args.channel]
+            events.remove_app(app.id, ch.id)
+            events.init_app(app.id, ch.id)
+        else:
+            events.remove_app(app.id)
+            events.init_app(app.id)
+        _ok(f"Data of app {args.name!r} deleted.")
+    elif sub == "channel-new":
+        app = meta.app_get_by_name(args.name)
+        if app is None:
+            _die(f"App {args.name!r} not found.")
+        ch = meta.channel_insert(app.id, args.channel)
+        if ch is None:
+            _die(f"Invalid or duplicate channel name {args.channel!r} "
+                 "(must match [a-zA-Z0-9-]{1,16}).")
+        events.init_app(app.id, ch.id)
+        _ok(f"Channel created: id={ch.id} name={ch.name}")
+    elif sub == "channel-delete":
+        app = meta.app_get_by_name(args.name)
+        if app is None:
+            _die(f"App {args.name!r} not found.")
+        chans = {c.name: c for c in meta.channel_get_by_appid(app.id)}
+        if args.channel not in chans:
+            _die(f"Channel {args.channel!r} not found.")
+        ch = chans[args.channel]
+        events.remove_app(app.id, ch.id)
+        meta.channel_delete(ch.id)
+        _ok(f"Channel {args.channel!r} deleted.")
+    return 0
+
+
+def cmd_accesskey(args) -> int:
+    meta = _storage().get_metadata()
+    sub = args.ak_command
+    if sub == "new":
+        app = meta.app_get_by_name(args.app_name)
+        if app is None:
+            _die(f"App {args.app_name!r} not found.")
+        ak = meta.access_key_insert(app.id, events=tuple(args.event or ()))
+        _ok(f"Access key: {ak.key}")
+    elif sub == "list":
+        keys = meta.access_key_get_all()
+        if args.app_name:
+            app = meta.app_get_by_name(args.app_name)
+            if app is None:
+                _die(f"App {args.app_name!r} not found.")
+            keys = [k for k in keys if k.appid == app.id]
+        for k in keys:
+            _ok(f"  {k.key}  appid={k.appid}  events={list(k.events) or 'all'}")
+    elif sub == "delete":
+        if meta.access_key_delete(args.key):
+            _ok("Access key deleted.")
+        else:
+            _die("Access key not found.")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# build / train / eval / deploy (Console.scala:772-869)
+# ---------------------------------------------------------------------------
+
+def cmd_build(args) -> int:
+    """Register the engine manifest (no compilation needed — the 'build'
+    is XLA tracing at train time). Reference: build = sbt package +
+    RegisterEngine (Console.scala:772-805)."""
+    from ..storage import EngineManifest
+
+    engine_dir = Path(args.engine_dir)
+    variant = _load_variant(engine_dir, args.engine_json)
+    _engine_from_variant(engine_dir, variant)  # import check = the "build"
+    engine_id, version, _ = _engine_ids(engine_dir, variant)
+    manifest = EngineManifest(
+        id=engine_id,
+        version=version,
+        name=engine_dir.resolve().name,
+        description=variant.get("description"),
+        files=(str(engine_dir.resolve()),),
+        engine_factory=variant.get("engineFactory", ""),
+    )
+    _storage().get_metadata().engine_manifest_insert(manifest)
+    _ok(f"Engine {engine_id}:{version} registered (factory import OK).")
+    return 0
+
+
+def cmd_unregister(args) -> int:
+    engine_dir = Path(args.engine_dir)
+    variant = _load_variant(engine_dir, args.engine_json)
+    engine_id, version, _ = _engine_ids(engine_dir, variant)
+    if _storage().get_metadata().engine_manifest_delete(engine_id, version):
+        _ok(f"Engine {engine_id}:{version} unregistered.")
+    else:
+        _die("Engine manifest not found.")
+    return 0
+
+
+def cmd_train(args) -> int:
+    from ..workflow import Context, WorkflowParams, run_train
+
+    engine_dir = Path(args.engine_dir)
+    variant = _load_variant(engine_dir, args.engine_json)
+    engine = _engine_from_variant(engine_dir, variant)
+    engine_id, version, variant_id = _engine_ids(engine_dir, variant)
+    engine_params = engine.engine_params_from_json(variant)
+    ctx = Context(
+        mode="Train",
+        batch=args.batch,
+        workflow_params=WorkflowParams(
+            batch=args.batch,
+            skip_sanity_check=args.skip_sanity_check,
+            stop_after_read=args.stop_after_read,
+            stop_after_prepare=args.stop_after_prepare,
+        ),
+        mesh_shape=_parse_mesh(args.mesh) if args.mesh else None,
+        mesh_axes=("data", "model") if args.mesh else None,
+    )
+    iid = run_train(
+        engine,
+        engine_params,
+        ctx,
+        engine_id=engine_id,
+        engine_version=version,
+        engine_variant=variant_id,
+        engine_factory=variant.get("engineFactory", ""),
+        batch=args.batch,
+    )
+    _ok(f"Training completed. Engine instance: {iid}")
+    return 0
+
+
+def _parse_mesh(spec: str) -> tuple[int, ...]:
+    return tuple(int(x) for x in spec.split("x"))
+
+
+def cmd_eval(args) -> int:
+    from ..workflow import Context, resolve_attr, run_evaluation
+
+    engine_dir = Path(args.engine_dir)
+    sys.path.insert(0, str(engine_dir))
+    ev_obj = resolve_attr(args.evaluation)
+    evaluation = ev_obj() if isinstance(ev_obj, type) else ev_obj
+    if args.engine_params_generator:
+        gen_obj = resolve_attr(args.engine_params_generator)
+        generator = gen_obj() if isinstance(gen_obj, type) else gen_obj
+        grid = list(generator.engine_params_list)
+    else:
+        grid = list(getattr(evaluation, "engine_params_list", ()))
+    if not grid:
+        _die("no EngineParams to evaluate (give an EngineParamsGenerator)")
+    iid, result = run_evaluation(
+        evaluation,
+        grid,
+        Context(mode="Evaluation", batch=args.batch),
+        evaluation_class=args.evaluation,
+        generator_class=args.engine_params_generator or "",
+        batch=args.batch,
+        best_json_path=str(engine_dir / "best.json"),
+    )
+    _ok(result.pretty_print())
+    _ok(f"Evaluation completed. Instance: {iid}; best params -> best.json")
+    return 0
+
+
+def cmd_deploy(args) -> int:
+    from ..workflow.create_server import run_engine_server
+
+    engine_dir = Path(args.engine_dir)
+    variant = _load_variant(engine_dir, args.engine_json)
+    engine = _engine_from_variant(engine_dir, variant)
+    engine_id, version, variant_id = _engine_ids(engine_dir, variant)
+    meta = _storage().get_metadata()
+    if args.engine_instance_id:
+        inst = meta.engine_instance_get(args.engine_instance_id)
+    else:
+        inst = meta.engine_instance_get_latest_completed(engine_id, version, variant_id)
+    if inst is None:
+        _die(f"No COMPLETED training of engine {engine_id} found. Run `pio train` first.")
+    run_engine_server(
+        engine,
+        inst,
+        ip=args.ip,
+        port=args.port,
+        feedback_url=args.event_server_url if args.feedback else None,
+        access_key=args.accesskey,
+    )
+    return 0
+
+
+def cmd_undeploy(args) -> int:
+    import requests
+
+    url = f"http://{args.ip}:{args.port}/stop"
+    try:
+        r = requests.get(url, timeout=5)
+        _ok(f"Undeploy requested: {r.json().get('message')}")
+        return 0
+    except Exception as e:
+        _die(f"cannot reach engine server at {url}: {e}")
+    return 1
+
+
+# ---------------------------------------------------------------------------
+# servers / status / import / export
+# ---------------------------------------------------------------------------
+
+def cmd_eventserver(args) -> int:
+    from ..api import run_event_server
+
+    run_event_server(ip=args.ip, port=args.port, stats=args.stats)
+    return 0
+
+
+def cmd_adminserver(args) -> int:
+    from ..tools.admin import run_admin_server
+
+    run_admin_server(ip=args.ip, port=args.port)
+    return 0
+
+
+def cmd_dashboard(args) -> int:
+    from ..tools.dashboard import run_dashboard
+
+    run_dashboard(ip=args.ip, port=args.port)
+    return 0
+
+
+def cmd_status(args) -> int:
+    """(reference `pio status`: storage verification, Console.scala:1061+)"""
+    _ok(f"predictionio_tpu {__version__}")
+    from ..storage import Storage
+
+    statuses = Storage.verify_all_data_objects()
+    for repo, st in statuses.items():
+        _ok(f"  {repo}: {st}")
+    try:
+        import jax
+
+        devs = jax.devices()
+        _ok(f"  devices: {len(devs)} x {devs[0].platform if devs else '-'}")
+    except Exception as e:  # noqa: BLE001
+        _ok(f"  devices: unavailable ({e})")
+    if all(s == "ok" for s in statuses.values()):
+        _ok("(sleeping 5 seconds for all messages to show up...)"
+            if False else "Your system is all ready to go.")
+        return 0
+    return 1
+
+
+def cmd_import(args) -> int:
+    from .import_export import import_events
+
+    n = import_events(args.input, args.appid, args.channel)
+    _ok(f"Imported {n} events to app {args.appid}.")
+    return 0
+
+
+def cmd_export(args) -> int:
+    from .import_export import export_events
+
+    n = export_events(args.output, args.appid, args.channel)
+    _ok(f"Exported {n} events from app {args.appid}.")
+    return 0
+
+
+def cmd_template(args) -> int:
+    from .templates import get_template, list_templates
+
+    if args.template_command == "list":
+        for name, desc in list_templates():
+            _ok(f"  {name:32s} {desc}")
+    else:
+        get_template(args.name, Path(args.directory or args.name))
+        _ok(f"Engine template {args.name!r} created at {args.directory or args.name}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# parser
+# ---------------------------------------------------------------------------
+
+def _add_engine_args(p: argparse.ArgumentParser):
+    p.add_argument("--engine-dir", default=".", help="engine directory")
+    p.add_argument("--engine-json", default="engine.json",
+                   help="engine variant file (reference --engine-variant)")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="pio", description="predictionio_tpu console"
+    )
+    p.add_argument("--verbose", "-v", action="store_true")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("version")
+
+    sp = sub.add_parser("app")
+    app_sub = sp.add_subparsers(dest="app_command", required=True)
+    x = app_sub.add_parser("new")
+    x.add_argument("name")
+    x.add_argument("--description")
+    x.add_argument("--access-key")
+    x = app_sub.add_parser("list")
+    x = app_sub.add_parser("show")
+    x.add_argument("name")
+    x = app_sub.add_parser("delete")
+    x.add_argument("name")
+    x = app_sub.add_parser("data-delete")
+    x.add_argument("name")
+    x.add_argument("--channel")
+    x = app_sub.add_parser("channel-new")
+    x.add_argument("name")
+    x.add_argument("channel")
+    x = app_sub.add_parser("channel-delete")
+    x.add_argument("name")
+    x.add_argument("channel")
+
+    sp = sub.add_parser("accesskey")
+    ak_sub = sp.add_subparsers(dest="ak_command", required=True)
+    x = ak_sub.add_parser("new")
+    x.add_argument("app_name")
+    x.add_argument("--event", action="append")
+    x = ak_sub.add_parser("list")
+    x.add_argument("app_name", nargs="?")
+    x = ak_sub.add_parser("delete")
+    x.add_argument("key")
+
+    for name in ("build", "unregister"):
+        sp = sub.add_parser(name)
+        _add_engine_args(sp)
+
+    sp = sub.add_parser("train")
+    _add_engine_args(sp)
+    sp.add_argument("--batch", default="")
+    sp.add_argument("--skip-sanity-check", action="store_true")
+    sp.add_argument("--stop-after-read", action="store_true")
+    sp.add_argument("--stop-after-prepare", action="store_true")
+    sp.add_argument("--mesh", help="mesh shape, e.g. 4x2 (data x model)")
+
+    sp = sub.add_parser("eval")
+    _add_engine_args(sp)
+    sp.add_argument("evaluation", help="module:EvaluationClass")
+    sp.add_argument("engine_params_generator", nargs="?",
+                    help="module:EngineParamsGenerator")
+    sp.add_argument("--batch", default="")
+
+    sp = sub.add_parser("deploy")
+    _add_engine_args(sp)
+    sp.add_argument("--ip", default="0.0.0.0")
+    sp.add_argument("--port", type=int, default=8000)
+    sp.add_argument("--engine-instance-id")
+    sp.add_argument("--feedback", action="store_true")
+    sp.add_argument("--event-server-url", default="http://localhost:7070")
+    sp.add_argument("--accesskey")
+
+    sp = sub.add_parser("undeploy")
+    sp.add_argument("--ip", default="localhost")
+    sp.add_argument("--port", type=int, default=8000)
+
+    sp = sub.add_parser("eventserver")
+    sp.add_argument("--ip", default="0.0.0.0")
+    sp.add_argument("--port", type=int, default=7070)
+    sp.add_argument("--stats", action="store_true")
+
+    sp = sub.add_parser("adminserver")
+    sp.add_argument("--ip", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=7071)
+
+    sp = sub.add_parser("dashboard")
+    sp.add_argument("--ip", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=9000)
+
+    sp = sub.add_parser("status")
+
+    sp = sub.add_parser("import")
+    sp.add_argument("--appid", type=int, required=True)
+    sp.add_argument("--channel", type=int, default=None)
+    sp.add_argument("--input", required=True)
+
+    sp = sub.add_parser("export")
+    sp.add_argument("--appid", type=int, required=True)
+    sp.add_argument("--channel", type=int, default=None)
+    sp.add_argument("--output", required=True)
+
+    sp = sub.add_parser("template")
+    t_sub = sp.add_subparsers(dest="template_command", required=True)
+    x = t_sub.add_parser("list")
+    x = t_sub.add_parser("get")
+    x.add_argument("name")
+    x.add_argument("directory", nargs="?")
+
+    return p
+
+
+COMMANDS = {
+    "app": cmd_app,
+    "accesskey": cmd_accesskey,
+    "build": cmd_build,
+    "unregister": cmd_unregister,
+    "train": cmd_train,
+    "eval": cmd_eval,
+    "deploy": cmd_deploy,
+    "undeploy": cmd_undeploy,
+    "eventserver": cmd_eventserver,
+    "adminserver": cmd_adminserver,
+    "dashboard": cmd_dashboard,
+    "status": cmd_status,
+    "import": cmd_import,
+    "export": cmd_export,
+    "template": cmd_template,
+}
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="[%(levelname)s] [%(name)s] %(message)s",
+    )
+    if args.command == "version":
+        print(__version__)
+        return 0
+    return COMMANDS[args.command](args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,51 @@
+"""Engine-template scaffolding.
+
+Analog of reference ``Template`` (tools/src/main/scala/io/prediction/tools/
+console/Template.scala:1-427), which downloads templates from GitHub.
+This environment is zero-egress, so templates ship inside the repo's
+``templates/`` directory and `pio template get` copies one into place.
+"""
+
+from __future__ import annotations
+
+import shutil
+from pathlib import Path
+
+__all__ = ["list_templates", "get_template", "templates_root"]
+
+
+def templates_root() -> Path:
+    return Path(__file__).resolve().parents[2] / "templates"
+
+
+_DESCRIPTIONS = {
+    "recommendation": "ALS rating-based recommender (scala-parallel-recommendation)",
+    "similarproduct": "implicit-ALS similar items (scala-parallel-similarproduct)",
+    "classification": "NaiveBayes / logistic-regression classifier (scala-parallel-classification)",
+    "ecommercerecommendation": "ALS + real-time availability filters (scala-parallel-ecommercerecommendation)",
+    "twotower": "two-tower neural retrieval (JAX user/item encoders)",
+}
+
+
+def list_templates() -> list[tuple[str, str]]:
+    root = templates_root()
+    out = []
+    if root.exists():
+        for d in sorted(root.iterdir()):
+            if d.is_dir() and (d / "engine.json").exists():
+                out.append((d.name, _DESCRIPTIONS.get(d.name, "")))
+    return out
+
+
+def get_template(name: str, dest: Path) -> Path:
+    src = templates_root() / name
+    if not (src / "engine.json").exists():
+        available = ", ".join(n for n, _ in list_templates()) or "(none)"
+        raise FileNotFoundError(
+            f"template {name!r} not found; available: {available}"
+        )
+    dest = Path(dest)
+    if dest.exists() and any(dest.iterdir()):
+        raise FileExistsError(f"destination {dest} exists and is not empty")
+    shutil.copytree(src, dest, dirs_exist_ok=True)
+    return dest

@@ -1,0 +1,237 @@
+"""Engine server: deploys a trained engine instance as an HTTP service.
+
+Analog of reference ``CreateServer`` (core/src/main/scala/io/prediction/
+workflow/CreateServer.scala:106-613) on asyncio/aiohttp instead of
+spray/akka actors:
+
+- ``POST /queries.json``  -> serve one query (the hot path, :462-591)
+- ``GET  /``              -> engine status JSON (Twirl HTML page analog)
+- ``GET  /reload``        -> hot-swap to the latest COMPLETED instance
+  (MasterActor's UpgradeActor/ReloadServer, :592-598) — models are
+  rehydrated into a fresh ``Deployed`` bundle, then the reference is
+  swapped atomically (double-buffering; on-device factor arrays from the
+  old bundle are dropped after the swap).
+- ``GET  /stop``          -> graceful shutdown (:600-608)
+- feedback loop: when enabled, every query/prediction pair is POSTed to
+  the event server with prId threading (:488-541).
+
+Queries are parsed with the algorithm's ``query_class`` dataclass when
+declared (the reference's per-algorithm querySerializer), else passed as
+raw dicts; predictions are serialized from dataclasses or plain JSON
+values.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import logging
+import time
+import uuid
+from datetime import datetime, timezone
+from typing import Any
+
+from aiohttp import web
+
+from ..controller.engine import Engine, TrainResult
+from ..controller.params import parse_params
+from ..storage import EngineInstance, Storage
+from .context import Context
+from .core_workflow import prepare_deploy
+
+log = logging.getLogger("predictionio_tpu.server")
+
+__all__ = ["EngineServer", "create_engine_server_app", "run_engine_server"]
+
+
+def _to_jsonable(x: Any) -> Any:
+    if dataclasses.is_dataclass(x) and not isinstance(x, type):
+        return {k: _to_jsonable(v) for k, v in dataclasses.asdict(x).items()}
+    if isinstance(x, dict):
+        return {k: _to_jsonable(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [_to_jsonable(v) for v in x]
+    if hasattr(x, "item") and not isinstance(x, (str, bytes)):  # numpy scalar
+        try:
+            return x.item()
+        except Exception:
+            return x
+    return x
+
+
+@dataclasses.dataclass
+class Deployed:
+    """One rehydrated engine instance (swap unit for hot reload)."""
+
+    instance: EngineInstance
+    result: TrainResult
+
+
+class EngineServer:
+    """Holds the deployed bundle + bookkeeping; handlers delegate here."""
+
+    def __init__(
+        self,
+        engine: Engine,
+        instance: EngineInstance,
+        ctx: Context | None = None,
+        *,
+        feedback_url: str | None = None,
+        access_key: str | None = None,
+    ):
+        self.engine = engine
+        self.ctx = ctx or Context(mode="Serving")
+        self.deployed = Deployed(instance, prepare_deploy(engine, instance, self.ctx))
+        self.feedback_url = feedback_url
+        self.access_key = access_key
+        self.start_time = datetime.now(timezone.utc)
+        # bookkeeping (CreateServer.scala:396-398)
+        self.request_count = 0
+        self.avg_serving_sec = 0.0
+        self.last_serving_sec = 0.0
+        self._swap_lock = asyncio.Lock()
+
+    # -- query hot path ----------------------------------------------------
+    def serve_query(self, query_json: dict) -> dict:
+        t0 = time.perf_counter()
+        bundle = self.deployed  # snapshot reference (atomic swap safety)
+        result = bundle.result
+        predictions = []
+        for algo, model in zip(result.algorithms, result.models):
+            qcls = getattr(algo, "query_class", None)
+            q = parse_params(qcls, query_json) if qcls is not None else query_json
+            predictions.append(algo.predict(model, q))
+        first_q = query_json
+        qcls0 = getattr(result.algorithms[0], "query_class", None)
+        if qcls0 is not None:
+            first_q = parse_params(qcls0, query_json)
+        served = result.serving.serve(first_q, predictions)
+        dt = time.perf_counter() - t0
+        self.request_count += 1
+        self.last_serving_sec = dt
+        self.avg_serving_sec += (dt - self.avg_serving_sec) / self.request_count
+        return _to_jsonable(served)
+
+    # -- hot reload (MasterActor ReloadServer, :315-336) -------------------
+    def reload_latest(self) -> str:
+        meta = Storage.get_metadata()
+        inst = self.deployed.instance
+        latest = meta.engine_instance_get_latest_completed(
+            inst.engine_id, inst.engine_version, inst.engine_variant
+        )
+        if latest is None:
+            raise RuntimeError("no COMPLETED engine instance to reload")
+        fresh = Deployed(latest, prepare_deploy(self.engine, latest, self.ctx))
+        self.deployed = fresh  # atomic reference swap
+        log.info("Reloaded engine instance %s", latest.id)
+        return latest.id
+
+    def status(self) -> dict:
+        inst = self.deployed.instance
+        return {
+            "status": "alive",
+            "engineInstanceId": inst.id,
+            "engineVariant": inst.engine_variant,
+            "engineFactory": inst.engine_factory,
+            "startTime": self.start_time.isoformat(),
+            "requestCount": self.request_count,
+            "avgServingSec": self.avg_serving_sec,
+            "lastServingSec": self.last_serving_sec,
+            "algorithms": [type(a).__name__ for a in self.deployed.result.algorithms],
+        }
+
+    async def send_feedback(self, query_json: dict, prediction: dict, pr_id: str) -> None:
+        """POST the (query, prediction) pair back to the event server
+        (CreateServer.scala:524-530)."""
+        if not self.feedback_url or not self.access_key:
+            return
+        import aiohttp
+
+        event = {
+            "event": "predict",
+            "entityType": "pio_pr",
+            "entityId": pr_id,
+            "properties": {"query": query_json, "prediction": prediction},
+            "prId": pr_id,
+        }
+        try:
+            async with aiohttp.ClientSession() as session:
+                await session.post(
+                    f"{self.feedback_url}/events.json",
+                    params={"accessKey": self.access_key},
+                    json=event,
+                    timeout=aiohttp.ClientTimeout(total=5),
+                )
+        except Exception as e:  # feedback is best-effort (reference logs only)
+            log.warning("feedback POST failed: %s", e)
+
+
+SERVER_KEY = web.AppKey("engine_server", EngineServer)
+
+
+async def handle_query(request: web.Request) -> web.Response:
+    server: EngineServer = request.app[SERVER_KEY]
+    try:
+        query_json = await request.json()
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        return web.json_response({"message": "Malformed JSON body."}, status=400)
+    if not isinstance(query_json, dict):
+        return web.json_response({"message": "Query must be a JSON object."}, status=400)
+    try:
+        result = await asyncio.to_thread(server.serve_query, query_json)
+    except Exception as e:  # noqa: BLE001 — surface as 400 like the reference
+        log.exception("query failed")
+        return web.json_response({"message": str(e)}, status=400)
+    if server.feedback_url:
+        pr_id = uuid.uuid4().hex
+        result_with_pr = {**result, "prId": pr_id} if isinstance(result, dict) else result
+        asyncio.create_task(server.send_feedback(query_json, result, pr_id))
+        return web.json_response(result_with_pr)
+    return web.json_response(result)
+
+
+async def handle_status(request: web.Request) -> web.Response:
+    return web.json_response(request.app[SERVER_KEY].status())
+
+
+async def handle_reload(request: web.Request) -> web.Response:
+    server: EngineServer = request.app[SERVER_KEY]
+    try:
+        iid = await asyncio.to_thread(server.reload_latest)
+    except Exception as e:  # noqa: BLE001
+        return web.json_response({"message": str(e)}, status=500)
+    return web.json_response({"message": "Reloaded", "engineInstanceId": iid})
+
+
+async def handle_stop(request: web.Request) -> web.Response:
+    async def _stop():
+        await asyncio.sleep(0.1)
+        raise web.GracefulExit()
+
+    asyncio.create_task(_stop())
+    return web.json_response({"message": "Shutting down."})
+
+
+def create_engine_server_app(server: EngineServer) -> web.Application:
+    app = web.Application()
+    app[SERVER_KEY] = server
+    app.router.add_post("/queries.json", handle_query)
+    app.router.add_get("/", handle_status)
+    app.router.add_get("/reload", handle_reload)
+    app.router.add_get("/stop", handle_stop)
+    return app
+
+
+def run_engine_server(
+    engine: Engine,
+    instance: EngineInstance,
+    ip: str = "0.0.0.0",
+    port: int = 8000,
+    **kwargs,
+) -> None:
+    """Blocking entry (reference default port 8000, ServerConfig :77-92)."""
+    logging.basicConfig(level=logging.INFO)
+    server = EngineServer(engine, instance, **kwargs)
+    log.info("Engine server (instance %s) starting on %s:%d", instance.id, ip, port)
+    web.run_app(create_engine_server_app(server), host=ip, port=port, print=None)

@@ -9,8 +9,21 @@ jax initializes, hence module-level os.environ mutation here.
 
 import os
 
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the ambient env may point JAX at the real TPU
+# (JAX_PLATFORMS=axon, set programmatically by the axon sitecustomize) —
+# tests always run on the virtual CPU mesh.
+import re as _re
+
+_flags = _re.sub(
+    r"--xla_force_host_platform_device_count=\d+", "",
+    os.environ.get("XLA_FLAGS", ""),
+)
+os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
@@ -32,7 +45,6 @@ def clean_storage():
 
 @pytest.fixture(scope="session")
 def mesh8():
-    import jax
     from predictionio_tpu.parallel.mesh import make_mesh
 
     devices = jax.devices()

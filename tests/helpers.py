@@ -1,0 +1,45 @@
+"""Shared test helpers."""
+
+import asyncio
+import threading
+
+
+class ServerThread:
+    """Run an aiohttp app on an ephemeral port in a daemon thread."""
+
+    def __init__(self, app_factory):
+        from aiohttp import web
+
+        self._loop = asyncio.new_event_loop()
+        self._ready = threading.Event()
+        self.port = None
+
+        async def _start():
+            runner = web.AppRunner(app_factory())
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            self.port = runner.addresses[0][1]
+            self._runner = runner
+            self._ready.set()
+
+        def _run():
+            asyncio.set_event_loop(self._loop)
+            self._loop.run_until_complete(_start())
+            self._loop.run_forever()
+
+        self._thread = threading.Thread(target=_run, daemon=True)
+        self._thread.start()
+        assert self._ready.wait(10)
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.port}"
+
+    def stop(self):
+        async def _stop():
+            await self._runner.cleanup()
+            self._loop.stop()
+
+        asyncio.run_coroutine_threadsafe(_stop(), self._loop)
+        self._thread.join(timeout=5)

@@ -1,0 +1,191 @@
+"""End-to-end quickstart: app new -> import -> train -> deploy -> query.
+
+The automated version of the reference's manual quickstart scripts
+(examples/.../data/import_eventserver.py + send_query.py) — the full
+L1-L8 slice the reference never tests automatically."""
+
+import json
+import shutil
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+import requests
+
+from predictionio_tpu.storage import Storage
+from predictionio_tpu.tools.cli import main as pio
+from tests.helpers import ServerThread
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture
+def engine_dir(tmp_path):
+    d = tmp_path / "myrec"
+    shutil.copytree(REPO / "templates" / "recommendation", d)
+    variant = json.loads((d / "engine.json").read_text())
+    variant["datasource"]["params"]["app_name"] = "qtest"
+    (d / "engine.json").write_text(json.dumps(variant))
+    yield d
+    sys.path[:] = [p for p in sys.path if p != str(d)]
+    for mod in ("engine",):
+        sys.modules.pop(mod, None)
+
+
+def make_events_file(path, rng, nu=30, ni=20):
+    """Low-rank preference structure so recommendations are learnable."""
+    u = rng.normal(size=(nu, 3)) + 1
+    v = rng.normal(size=(ni, 3)) + 1
+    full = u @ v.T
+    lines = []
+    for uu in range(nu):
+        for ii in range(ni):
+            if rng.random() < 0.6:
+                lines.append(json.dumps({
+                    "event": "rate",
+                    "entityType": "user", "entityId": f"u{uu}",
+                    "targetEntityType": "item", "targetEntityId": f"i{ii}",
+                    "properties": {"rating": float(full[uu, ii])},
+                    "eventTime": "2020-01-01T00:00:00Z",
+                }))
+    # a few buy events exercise the implicit branch
+    lines.append(json.dumps({
+        "event": "buy", "entityType": "user", "entityId": "u0",
+        "targetEntityType": "item", "targetEntityId": "i1",
+        "eventTime": "2020-01-02T00:00:00Z",
+    }))
+    Path(path).write_text("\n".join(lines))
+    return len(lines)
+
+
+def test_quickstart(engine_dir, tmp_path, rng, capsys):
+    # pio app new
+    assert pio(["app", "new", "qtest"]) == 0
+    app = Storage.get_metadata().app_get_by_name("qtest")
+
+    # pio import
+    events_file = tmp_path / "events.jsonl"
+    n = make_events_file(events_file, rng)
+    assert pio(["import", "--appid", str(app.id), "--input", str(events_file)]) == 0
+    out = capsys.readouterr().out
+    assert f"Imported {n} events" in out
+
+    # pio build (manifest + factory import check)
+    assert pio(["build", "--engine-dir", str(engine_dir)]) == 0
+
+    # pio train
+    assert pio(["train", "--engine-dir", str(engine_dir)]) == 0
+    insts = Storage.get_metadata().engine_instance_get_completed("default", "1", "default")
+    assert len(insts) == 1
+
+    # pio status
+    assert pio(["status"]) == 0
+
+    # deploy (in-thread server instead of the blocking CLI runner)
+    from predictionio_tpu.workflow.create_server import (
+        EngineServer,
+        create_engine_server_app,
+    )
+    from predictionio_tpu.workflow import resolve_engine_factory
+
+    sys.path.insert(0, str(engine_dir))
+    engine = resolve_engine_factory("engine:engine_factory")
+    server = EngineServer(engine, insts[0])
+    st = ServerThread(lambda: create_engine_server_app(server))
+    try:
+        # status page
+        r = requests.get(st.url + "/")
+        assert r.status_code == 200
+        assert r.json()["engineInstanceId"] == insts[0].id
+
+        # the quickstart query (send_query.py analog)
+        r = requests.post(st.url + "/queries.json", json={"user": "u3", "num": 4})
+        assert r.status_code == 200
+        scores = r.json()["itemScores"]
+        assert len(scores) == 4
+        assert scores[0]["score"] >= scores[-1]["score"]
+        assert all(s["item"].startswith("i") for s in scores)
+
+        # unknown user -> empty result, not an error
+        r = requests.post(st.url + "/queries.json", json={"user": "nope", "num": 4})
+        assert r.status_code == 200
+        assert r.json()["itemScores"] == []
+
+        # malformed query -> 400
+        r = requests.post(st.url + "/queries.json", json={"wrong": 1})
+        assert r.status_code == 400
+
+        # train again, then hot reload picks the newer instance
+        assert pio(["train", "--engine-dir", str(engine_dir)]) == 0
+        r = requests.get(st.url + "/reload")
+        assert r.status_code == 200
+        new_id = r.json()["engineInstanceId"]
+        assert new_id != insts[0].id
+        r = requests.get(st.url + "/")
+        assert r.json()["engineInstanceId"] == new_id
+        assert r.json()["requestCount"] >= 2
+    finally:
+        st.stop()
+
+    # export roundtrip
+    out_file = tmp_path / "export.jsonl"
+    assert pio(["export", "--appid", str(app.id), "--output", str(out_file)]) == 0
+    assert len(out_file.read_text().splitlines()) == n
+
+
+def test_template_list_and_get(tmp_path, capsys):
+    assert pio(["template", "list"]) == 0
+    out = capsys.readouterr().out
+    assert "recommendation" in out
+    dest = tmp_path / "fresh"
+    assert pio(["template", "get", "recommendation", str(dest)]) == 0
+    assert (dest / "engine.json").exists()
+
+
+def test_eval_via_cli(engine_dir, tmp_path, rng, capsys):
+    """pio eval with an Evaluation + EngineParamsGenerator defined in the
+    engine dir (reference quickstart tuning flow)."""
+    assert pio(["app", "new", "qtest"]) == 0
+    app = Storage.get_metadata().app_get_by_name("qtest")
+    events_file = tmp_path / "events.jsonl"
+    make_events_file(events_file, rng, nu=20, ni=12)
+    assert pio(["import", "--appid", str(app.id), "--input", str(events_file)]) == 0
+
+    (engine_dir / "evaluation.py").write_text('''
+from dataclasses import dataclass
+from predictionio_tpu.controller import (AverageMetric, EngineParams,
+                                         EngineParamsGenerator, Evaluation)
+from engine import DataSourceParams, AlgorithmParams, engine_factory
+
+class RMSEMetric(AverageMetric):
+    lower_is_better = True
+    def calculate_qpa(self, q, p, a):
+        for isc in p.itemScores:
+            if isc.item == a["item"]:
+                return (isc.score - a["rating"]) ** 2
+        return None
+    def header(self):
+        return "MSE(hit)"
+
+class MyEval(Evaluation):
+    engine = engine_factory()
+    metric = RMSEMetric()
+
+class MyGrid(EngineParamsGenerator):
+    engine_params_list = [
+        EngineParams(
+            data_source_params=("", DataSourceParams(app_name="qtest", eval_k=2)),
+            algorithm_params_list=(("als", AlgorithmParams(rank=r, num_iterations=5)),),
+        )
+        for r in (2, 4)
+    ]
+''')
+    assert pio([
+        "eval", "--engine-dir", str(engine_dir),
+        "evaluation:MyEval", "evaluation:MyGrid",
+    ]) == 0
+    out = capsys.readouterr().out
+    assert "leaderboard" in out
+    assert (engine_dir / "best.json").exists()
+    sys.modules.pop("evaluation", None)
