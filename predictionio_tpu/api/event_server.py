@@ -53,6 +53,8 @@ STATS_KEY = web.AppKey("stats", object)
 class AuthData:
     app_id: int
     channel_id: int | None
+    #: allowed event names; empty = all (AccessKeys.scala:27-34)
+    events: tuple = ()
 
 
 def _json_error(status: int, message: str) -> web.Response:
@@ -70,11 +72,11 @@ async def _authenticate(request: web.Request) -> AuthData | web.Response:
         return _json_error(401, "Invalid accessKey.")
     channel = request.query.get("channel")
     if channel is None:
-        return AuthData(app_id=ak.appid, channel_id=None)
+        return AuthData(app_id=ak.appid, channel_id=None, events=tuple(ak.events))
     channels = await asyncio.to_thread(meta.channel_get_by_appid, ak.appid)
     for ch in channels:
         if ch.name == channel:
-            return AuthData(app_id=ak.appid, channel_id=ch.id)
+            return AuthData(app_id=ak.appid, channel_id=ch.id, events=tuple(ak.events))
     return _json_error(401, f"Invalid channel '{channel}'.")
 
 
@@ -94,6 +96,10 @@ async def _insert_event_dict(
         event = event_from_api_dict(data)
     except ValidationError as e:
         return 400, {"message": str(e)}
+    if auth.events and event.event not in auth.events:
+        return 403, {
+            "message": f"event {event.event!r} is not allowed by this access key"
+        }
     events = Storage.get_events()
     try:
         event_id = await asyncio.to_thread(

@@ -71,6 +71,7 @@ class MetricEvaluatorResult:
     metric_header: str
     other_metric_headers: list[str]
     engine_params_scores: list[tuple[EngineParams, MetricScores]]
+    lower_is_better: bool = False
 
     def to_one_liner(self) -> str:
         return f"[{self.best_score.score}] {self.best_engine_params.to_json_dict()['algorithmsParams']}"
@@ -116,7 +117,7 @@ class MetricEvaluatorResult:
         order = sorted(
             range(len(self.engine_params_scores)),
             key=lambda i: self.engine_params_scores[i][1].score,
-            reverse=True,
+            reverse=not self.lower_is_better,
         )
         for rank, i in enumerate(order):
             ep, ms = self.engine_params_scores[i]
@@ -163,6 +164,7 @@ class MetricEvaluator:
             metric_header=self.metric.header(),
             other_metric_headers=[m.header() for m in self.other_metrics],
             engine_params_scores=scored,
+            lower_is_better=self.metric.lower_is_better,
         )
         if self.best_json_path:
             with open(self.best_json_path, "w") as f:

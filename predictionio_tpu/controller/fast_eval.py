@@ -90,9 +90,19 @@ class FastEvalEngine(Engine):
         _names, algos = self.make_algorithms(engine_params)
         serving = self.make_serving(engine_params)
         out: list[EvalFold] = []
-        for (pd, eval_info, qa), models in zip(prepared, per_fold_models):
+        for fold_idx, ((pd, eval_info, qa), models) in enumerate(
+            zip(prepared, per_fold_models)
+        ):
             indexed = [(i, q) for i, (q, _a) in enumerate(qa)]
-            per_algo = [dict(a.batch_predict(m, indexed)) for a, m in zip(algos, models)]
+            per_algo = []
+            for a, m in zip(algos, models):
+                preds = dict(a.batch_predict(m, indexed))
+                if len(preds) != len(indexed):
+                    raise ValueError(
+                        f"algorithm {type(a).__name__} returned predictions for "
+                        f"{len(preds)}/{len(indexed)} queries in fold {fold_idx}"
+                    )
+                per_algo.append(preds)
             qpa = [
                 (q, serving.serve(q, [preds[i] for preds in per_algo]), a)
                 for i, (q, a) in enumerate(qa)

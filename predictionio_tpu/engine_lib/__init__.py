@@ -1,0 +1,12 @@
+"""Engine-building library — the ``e2`` module analog (reference:
+e2/src/main/scala/io/prediction/e2/): reusable algorithms and evaluation
+helpers with no framework dependencies."""
+
+from .categorical_nb import CategoricalNaiveBayesModel, train_categorical_nb
+from .cross_validation import split_data
+from .markov_chain import MarkovChainModel, train_markov_chain
+
+__all__ = [
+    "CategoricalNaiveBayesModel", "MarkovChainModel", "split_data",
+    "train_categorical_nb", "train_markov_chain",
+]

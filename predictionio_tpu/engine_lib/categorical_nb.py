@@ -1,0 +1,104 @@
+"""Naive Bayes over string-valued categorical features.
+
+Analog of reference ``CategoricalNaiveBayes`` (e2/src/main/scala/io/
+prediction/e2/engine/CategoricalNaiveBayes.scala:23-176): labeled points
+whose features are category strings per position; the model scores a point
+per label as log prior + sum of per-position conditional log likelihoods,
+with a pluggable default for feature values unseen at training
+(logScore(point, defaultLikelihood), :103-140).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from collections import Counter, defaultdict
+from typing import Callable, Sequence
+
+__all__ = ["CategoricalNaiveBayesModel", "train_categorical_nb", "LabeledPoint"]
+
+
+@dataclasses.dataclass(frozen=True)
+class LabeledPoint:
+    """(reference e2/.../engine/LabeledPoint.scala)"""
+
+    label: str
+    features: tuple
+
+    def __str__(self):
+        return f"({self.label}, {self.features})"
+
+
+@dataclasses.dataclass
+class CategoricalNaiveBayesModel:
+    """priors: label -> log P(label); likelihoods:
+    label -> [per-position {value -> log P(value|label)}]."""
+
+    priors: dict
+    likelihoods: dict
+
+    def log_score(
+        self,
+        point: LabeledPoint,
+        default_likelihood: Callable[[Sequence[float]], float] | None = None,
+    ) -> float | None:
+        """Score ``point.features`` under ``point.label``
+        (CategoricalNaiveBayes.scala:103-140). Unseen feature values use
+        ``default_likelihood`` (given the position's known log likelihoods);
+        without one, returns None."""
+        label = point.label
+        if label not in self.priors:
+            return None
+        ll = self.likelihoods[label]
+        if len(point.features) != len(ll):
+            raise ValueError(
+                f"point has {len(point.features)} features, model expects {len(ll)}"
+            )
+        total = self.priors[label]
+        for pos, value in enumerate(point.features):
+            table = ll[pos]
+            if value in table:
+                total += table[value]
+            elif default_likelihood is not None:
+                total += default_likelihood(list(table.values()))
+            else:
+                return None
+        return total
+
+    def predict(self, features: Sequence[str]) -> str:
+        """Argmax label, scoring unseen values with the position's min
+        likelihood (CategoricalNaiveBayes.predict, :143-166)."""
+        best, best_score = None, -math.inf
+        for label in self.priors:
+            s = self.log_score(
+                LabeledPoint(label, tuple(features)),
+                default_likelihood=lambda lls: min(lls) if lls else -math.inf,
+            )
+            if s is not None and s > best_score:
+                best, best_score = label, s
+        return best
+
+
+def train_categorical_nb(points: Sequence[LabeledPoint]) -> CategoricalNaiveBayesModel:
+    """(CategoricalNaiveBayes.train, :29-100)"""
+    if not points:
+        raise ValueError("no labeled points")
+    n_features = len(points[0].features)
+    label_counts: Counter = Counter()
+    value_counts: dict = defaultdict(lambda: [Counter() for _ in range(n_features)])
+    for p in points:
+        if len(p.features) != n_features:
+            raise ValueError("inconsistent feature arity")
+        label_counts[p.label] += 1
+        for pos, v in enumerate(p.features):
+            value_counts[p.label][pos][v] += 1
+    total = sum(label_counts.values())
+    priors = {lb: math.log(c / total) for lb, c in label_counts.items()}
+    likelihoods = {
+        lb: [
+            {v: math.log(c / label_counts[lb]) for v, c in value_counts[lb][pos].items()}
+            for pos in range(n_features)
+        ]
+        for lb in label_counts
+    }
+    return CategoricalNaiveBayesModel(priors=priors, likelihoods=likelihoods)

@@ -1,0 +1,33 @@
+"""k-fold data splitting for evaluation.
+
+Analog of reference ``CrossValidation`` (e2/src/main/scala/io/prediction/
+e2/evaluation/CrossValidation.scala:285-320): element i goes to test fold
+``i % k``; yields (training subset, eval info, test subset) per fold —
+the same deterministic modulo split the reference uses so results are
+reproducible without shuffling.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence, TypeVar
+
+T = TypeVar("T")
+Q = TypeVar("Q")
+A = TypeVar("A")
+
+__all__ = ["split_data"]
+
+
+def split_data(
+    eval_k: int,
+    data: Sequence[T],
+    to_query_actual: Callable[[T], tuple[Q, A]],
+) -> list[tuple[list[T], dict, list[tuple[Q, A]]]]:
+    if eval_k < 2:
+        raise ValueError("eval_k must be >= 2")
+    folds = []
+    for fold in range(eval_k):
+        train = [x for i, x in enumerate(data) if i % eval_k != fold]
+        test = [to_query_actual(x) for i, x in enumerate(data) if i % eval_k == fold]
+        folds.append((train, {"fold": fold}, test))
+    return folds

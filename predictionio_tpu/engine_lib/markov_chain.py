@@ -1,0 +1,64 @@
+"""Markov chain with top-N-sparse transition rows.
+
+Analog of reference ``MarkovChain`` (e2/src/main/scala/io/prediction/e2/
+engine/MarkovChain.scala:201-260): from a sparse transition-count matrix,
+keep each row's top-N outgoing transitions normalized by the row sum;
+``predict(state)`` returns those (next_state, prob) pairs. The count
+matrix is built with one np.add.at scatter instead of the reference's
+CoordinateMatrix -> RowMatrix pipeline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+__all__ = ["MarkovChainModel", "train_markov_chain"]
+
+
+@dataclasses.dataclass
+class MarkovChainModel:
+    """transition_cols[i]/transition_probs[i]: top-N targets of state i."""
+
+    n_states: int
+    top_n: int
+    transition_cols: list  # list[np.ndarray[int]]
+    transition_probs: list  # list[np.ndarray[float]]
+
+    def predict(self, state: int) -> list[tuple[int, float]]:
+        if not (0 <= state < self.n_states):
+            raise IndexError(f"state {state} out of range 0..{self.n_states - 1}")
+        return list(
+            zip(self.transition_cols[state].tolist(),
+                self.transition_probs[state].tolist())
+        )
+
+
+def train_markov_chain(
+    from_states: np.ndarray,
+    to_states: np.ndarray,
+    counts: np.ndarray,
+    n_states: int,
+    top_n: int,
+) -> MarkovChainModel:
+    """COO transition counts -> row-normalized top-N model
+    (MarkovChain.scala:208-245 sparsifies each row to topN by probability)."""
+    dense = np.zeros((n_states, n_states), np.float64)
+    np.add.at(dense, (from_states, to_states), counts)
+    row_sums = dense.sum(axis=1)
+    cols, probs = [], []
+    for i in range(n_states):
+        row = dense[i]
+        nz = np.nonzero(row)[0]
+        if len(nz) == 0 or row_sums[i] == 0:
+            cols.append(np.zeros(0, np.int64))
+            probs.append(np.zeros(0, np.float64))
+            continue
+        order = nz[np.argsort(-row[nz], kind="stable")][:top_n]
+        cols.append(order)
+        probs.append(row[order] / row_sums[i])
+    return MarkovChainModel(
+        n_states=n_states, top_n=top_n,
+        transition_cols=cols, transition_probs=probs,
+    )

@@ -1,0 +1,150 @@
+"""Random forest classifier (host-side).
+
+Parity feature for the classification template's add-algorithm variant
+(reference: examples/scala-parallel-classification/add-algorithm/src/main/
+scala/RandomForestAlgorithm.scala, training MLlib RandomForest). Tree
+induction is branchy, data-dependent control flow — the opposite of what
+XLA compiles well — and the reference's use case is small tabular feature
+sets, so this runs as vectorized numpy on host: histogram-based greedy CART
+with gini impurity, bagging + feature subsampling per tree. Prediction is
+a vectorized walk usable on the serving path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+__all__ = ["RandomForestModel", "train_random_forest"]
+
+
+@dataclasses.dataclass
+class _Tree:
+    feature: np.ndarray  # [nodes] split feature (-1 = leaf)
+    threshold: np.ndarray  # [nodes]
+    left: np.ndarray  # [nodes] child index
+    right: np.ndarray  # [nodes]
+    leaf_class: np.ndarray  # [nodes] argmax class at node
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        n = x.shape[0]
+        node = np.zeros(n, dtype=np.int32)
+        while True:
+            feat = self.feature[node]
+            active = feat >= 0
+            if not active.any():
+                break
+            go_left = np.zeros(n, dtype=bool)
+            go_left[active] = (
+                x[np.nonzero(active)[0], feat[active]] <= self.threshold[node[active]]
+            )
+            nxt = np.where(go_left, self.left[node], self.right[node])
+            node = np.where(active, nxt, node)
+        return self.leaf_class[node]
+
+
+def _grow_tree(x, y_idx, n_classes, max_depth, min_leaf, feat_frac, rng):
+    nodes = {"feature": [], "threshold": [], "left": [], "right": [], "leaf": []}
+
+    def new_node():
+        for k in nodes:
+            nodes[k].append(-1 if k != "threshold" else 0.0)
+        return len(nodes["feature"]) - 1
+
+    def gini_gain(col, y, classes):
+        """Best threshold for one column by midpoint scan."""
+        order = np.argsort(col, kind="stable")
+        cs, ys = col[order], y[order]
+        n = len(ys)
+        onehot = np.zeros((n, classes), np.float64)
+        onehot[np.arange(n), ys] = 1.0
+        left_counts = np.cumsum(onehot, axis=0)  # [n, C] counts in left split
+        total = left_counts[-1]
+        valid = np.nonzero(cs[:-1] < cs[1:])[0]  # split between distinct values
+        if len(valid) == 0:
+            return None
+        nl = (valid + 1).astype(np.float64)
+        nr = n - nl
+        lc = left_counts[valid]
+        rc = total - lc
+        gini_l = 1.0 - ((lc / nl[:, None]) ** 2).sum(axis=1)
+        gini_r = 1.0 - ((rc / nr[:, None]) ** 2).sum(axis=1)
+        score = (nl * gini_l + nr * gini_r) / n
+        best = np.argmin(score)
+        thr = (cs[valid[best]] + cs[valid[best] + 1]) / 2.0
+        return score[best], thr
+
+    def build(idx, depth):
+        node = new_node()
+        y_here = y_idx[idx]
+        counts = np.bincount(y_here, minlength=n_classes)
+        nodes["leaf"][node] = int(np.argmax(counts))
+        if depth >= max_depth or len(idx) < 2 * min_leaf or counts.max() == len(idx):
+            return node
+        n_feat = x.shape[1]
+        k = max(1, int(round(n_feat * feat_frac)))
+        feats = rng.choice(n_feat, size=k, replace=False)
+        best = None
+        for f in feats:
+            res = gini_gain(x[idx, f], y_here, n_classes)
+            if res is not None and (best is None or res[0] < best[0]):
+                best = (res[0], f, res[1])
+        if best is None:
+            return node
+        _, f, thr = best
+        mask = x[idx, f] <= thr
+        if mask.sum() < min_leaf or (~mask).sum() < min_leaf:
+            return node
+        nodes["feature"][node] = int(f)
+        nodes["threshold"][node] = float(thr)
+        nodes["left"][node] = build(idx[mask], depth + 1)
+        nodes["right"][node] = build(idx[~mask], depth + 1)
+        return node
+
+    build(np.arange(x.shape[0]), 0)
+    return _Tree(
+        feature=np.asarray(nodes["feature"], np.int32),
+        threshold=np.asarray(nodes["threshold"], np.float64),
+        left=np.asarray(nodes["left"], np.int32),
+        right=np.asarray(nodes["right"], np.int32),
+        leaf_class=np.asarray(nodes["leaf"], np.int32),
+    )
+
+
+@dataclasses.dataclass
+class RandomForestModel:
+    trees: list
+    labels: np.ndarray
+    n_classes: int
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        x = np.atleast_2d(np.asarray(x, np.float64))
+        votes = np.zeros((x.shape[0], self.n_classes), np.int32)
+        for t in self.trees:
+            pred = t.predict(x)
+            votes[np.arange(x.shape[0]), pred] += 1
+        return self.labels[np.argmax(votes, axis=1)]
+
+
+def train_random_forest(
+    x: np.ndarray,
+    y: np.ndarray,
+    *,
+    num_trees: int = 10,
+    max_depth: int = 8,
+    min_leaf: int = 1,
+    feature_fraction: float = 0.7,
+    seed: int = 0,
+) -> RandomForestModel:
+    x = np.asarray(x, np.float64)
+    labels, y_idx = np.unique(y, return_inverse=True)
+    rng = np.random.default_rng(seed)
+    trees = []
+    for _ in range(num_trees):
+        bag = rng.integers(0, len(y_idx), len(y_idx))
+        trees.append(
+            _grow_tree(x[bag], y_idx[bag], len(labels), max_depth, min_leaf,
+                       feature_fraction, rng)
+        )
+    return RandomForestModel(trees=trees, labels=labels, n_classes=len(labels))

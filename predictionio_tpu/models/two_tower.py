@@ -1,0 +1,157 @@
+"""Two-tower neural retrieval (user/item encoders) on TPU.
+
+BASELINE.json config 5: "Two-tower neural retrieval (JAX user/item
+encoders) as drop-in PAlgorithm". No counterpart exists in the reference
+(it predates neural recommenders); this is the framework's native neural
+model family. Design:
+
+- Embedding + MLP towers (flax.linen), L2-normalized outputs, temperature-
+  scaled in-batch sampled-softmax loss (the standard retrieval recipe).
+- Data parallel over the mesh's ``data`` axis: batches are sharded, the
+  loss's in-batch negatives stay within the global batch via one logits
+  matmul (user_emb @ item_emb.T) — XLA all-gathers item embeddings across
+  shards automatically from the sharding annotations.
+- bfloat16 matmuls in the towers; float32 logits/loss.
+- Serving: item embeddings precomputed once; a query is one user-tower
+  forward + one [1, D] x [D, N] matmul + top-k.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import numpy as np
+
+from ..storage.bimap import BiMap
+from ..storage.frame import Ratings
+
+__all__ = ["TwoTowerConfig", "TwoTowerModel", "train_two_tower"]
+
+
+@dataclasses.dataclass(frozen=True)
+class TwoTowerConfig:
+    embed_dim: int = 64
+    hidden_dim: int = 128
+    out_dim: int = 32
+    batch_size: int = 1024
+    epochs: int = 5
+    lr: float = 1e-3
+    temperature: float = 0.1
+    seed: int = 0
+
+
+def _make_towers(n_users: int, n_items: int, cfg: TwoTowerConfig):
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    class Tower(nn.Module):
+        vocab: int
+
+        @nn.compact
+        def __call__(self, ids):
+            x = nn.Embed(self.vocab, cfg.embed_dim,
+                         embedding_init=nn.initializers.normal(0.02))(ids)
+            x = x.astype(jnp.bfloat16)
+            x = nn.Dense(cfg.hidden_dim, dtype=jnp.bfloat16)(x)
+            x = nn.relu(x)
+            x = nn.Dense(cfg.out_dim, dtype=jnp.bfloat16)(x)
+            x = x.astype(jnp.float32)
+            return x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + 1e-6)
+
+    return Tower(n_users), Tower(n_items)
+
+
+@dataclasses.dataclass
+class TwoTowerModel:
+    user_params: Any
+    item_params: Any
+    user_embeddings: np.ndarray  # [NU, D] precomputed
+    item_embeddings: np.ndarray  # [NI, D]
+    user_ids: BiMap
+    item_ids: BiMap
+    config: TwoTowerConfig
+
+    def recommend_products(self, user_id: str, num: int) -> list[tuple[str, float]]:
+        row = self.user_ids.get(user_id)
+        if row is None:
+            return []
+        scores = self.item_embeddings @ self.user_embeddings[row]
+        num = min(num, len(scores))
+        top = np.argpartition(-scores, num - 1)[:num]
+        top = top[np.argsort(-scores[top])]
+        inv = self.item_ids.inverse
+        return [(inv[int(i)], float(scores[i])) for i in top]
+
+
+def train_two_tower(ratings: Ratings, cfg: TwoTowerConfig, mesh=None) -> TwoTowerModel:
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    if mesh is None:
+        from ..parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+
+    nu, ni = ratings.num_users, ratings.num_items
+    if nu == 0 or ni == 0:
+        raise ValueError("empty ratings")
+    user_tower, item_tower = _make_towers(nu, ni, cfg)
+
+    key = jax.random.PRNGKey(cfg.seed)
+    ku, ki, kshuf = jax.random.split(key, 3)
+    u_params = user_tower.init(ku, jnp.zeros((2,), jnp.int32))
+    i_params = item_tower.init(ki, jnp.zeros((2,), jnp.int32))
+    params = {"user": u_params, "item": i_params}
+    opt = optax.adam(cfg.lr)
+    opt_state = opt.init(params)
+
+    data_sh = NamedSharding(mesh, P("data"))
+    rep = NamedSharding(mesh, P())
+
+    def loss_fn(p, u_ids, i_ids):
+        ue = user_tower.apply(p["user"], u_ids)  # [B, D]
+        ie = item_tower.apply(p["item"], i_ids)  # [B, D]
+        logits = (ue @ ie.T) / cfg.temperature  # [B, B] in-batch negatives
+        labels = jnp.arange(logits.shape[0])
+        # mask duplicate positives (same item appearing twice in batch)
+        dup = i_ids[None, :] == i_ids[:, None]
+        neg_mask = dup & (labels[None, :] != labels[:, None])
+        logits = jnp.where(neg_mask, -1e9, logits)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+    @jax.jit
+    def train_step(p, state, u_ids, i_ids):
+        loss, g = jax.value_and_grad(loss_fn)(p, u_ids, i_ids)
+        updates, state = opt.update(g, state)
+        return optax.apply_updates(p, updates), state, loss
+
+    n = len(ratings)
+    bs = min(cfg.batch_size, max(8, n))
+    # align batch to the data axis so shards stay equal
+    per = mesh.shape.get("data", 1)
+    bs = max(per, (bs // per) * per)
+    order = np.asarray(jax.random.permutation(kshuf, n))
+    losses = []
+    for _ep in range(cfg.epochs):
+        for start in range(0, n - bs + 1, bs):
+            idx = order[start : start + bs]
+            u_b = jax.device_put(ratings.user_indices[idx], data_sh)
+            i_b = jax.device_put(ratings.item_indices[idx], data_sh)
+            params, opt_state, loss = train_step(params, opt_state, u_b, i_b)
+        losses.append(float(loss))
+
+    # precompute embeddings for serving
+    u_emb = np.asarray(user_tower.apply(params["user"], jnp.arange(nu)))
+    i_emb = np.asarray(item_tower.apply(params["item"], jnp.arange(ni)))
+    return TwoTowerModel(
+        user_params=jax.tree_util.tree_map(np.asarray, params["user"]),
+        item_params=jax.tree_util.tree_map(np.asarray, params["item"]),
+        user_embeddings=u_emb,
+        item_embeddings=i_emb,
+        user_ids=ratings.user_ids,
+        item_ids=ratings.item_ids,
+        config=cfg,
+    )

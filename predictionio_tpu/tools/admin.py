@@ -1,0 +1,137 @@
+"""Admin REST API on :7071.
+
+Analog of reference ``AdminAPI``/``CommandClient`` (tools/src/main/scala/
+io/prediction/tools/admin/AdminAPI.scala:71-154, CommandClient.scala:1-159)
+— REST mirrors of the console's app commands:
+
+- ``GET    /``                     -> {"status": "alive"}
+- ``GET    /cmd/app``              -> list apps (+ access keys)
+- ``POST   /cmd/app``              -> create app {"name": ..., "description"?}
+- ``DELETE /cmd/app/<name>``       -> delete app
+- ``DELETE /cmd/app/<name>/data``  -> wipe app event data
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+
+from aiohttp import web
+
+from ..storage import Storage
+
+log = logging.getLogger("predictionio_tpu.admin")
+
+__all__ = ["create_admin_app", "run_admin_server"]
+
+
+async def handle_root(request: web.Request) -> web.Response:
+    return web.json_response({"status": "alive"})
+
+
+async def handle_app_list(request: web.Request) -> web.Response:
+    """(CommandClient.futureAppList, CommandClient.scala:105-113)"""
+    def work():
+        meta = Storage.get_metadata()
+        out = []
+        for app in meta.app_get_all():
+            keys = meta.access_key_get_by_appid(app.id)
+            out.append({
+                "name": app.name,
+                "id": app.id,
+                "accessKeys": [k.key for k in keys],
+            })
+        return out
+
+    apps = await asyncio.to_thread(work)
+    return web.json_response({"status": 0, "apps": apps})
+
+
+async def handle_app_new(request: web.Request) -> web.Response:
+    """(CommandClient.futureAppNew, CommandClient.scala:64-103)"""
+    try:
+        body = await request.json()
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        return web.json_response({"message": "Malformed JSON body."}, status=400)
+    name = body.get("name")
+    if not name or not isinstance(name, str):
+        return web.json_response({"message": "field name is required"}, status=400)
+
+    def work():
+        meta = Storage.get_metadata()
+        app = meta.app_insert(name, body.get("description"))
+        if app is None:
+            return None
+        Storage.get_events().init_app(app.id)
+        ak = meta.access_key_insert(app.id)
+        return app, ak
+
+    result = await asyncio.to_thread(work)
+    if result is None:
+        return web.json_response(
+            {"message": f"App {name} already exists. Aborting."}, status=409
+        )
+    app, ak = result
+    return web.json_response(
+        {"status": 1, "id": app.id, "name": app.name, "key": ak.key}, status=201
+    )
+
+
+async def handle_app_delete(request: web.Request) -> web.Response:
+    """(CommandClient.futureAppDelete, CommandClient.scala:137-154)"""
+    name = request.match_info["name"]
+
+    def work():
+        meta = Storage.get_metadata()
+        app = meta.app_get_by_name(name)
+        if app is None:
+            return False
+        events = Storage.get_events()
+        for ch in meta.channel_get_by_appid(app.id):
+            events.remove_app(app.id, ch.id)
+            meta.channel_delete(ch.id)
+        for ak in meta.access_key_get_by_appid(app.id):
+            meta.access_key_delete(ak.key)
+        events.remove_app(app.id)
+        meta.app_delete(app.id)
+        return True
+
+    if await asyncio.to_thread(work):
+        return web.json_response({"status": 0, "message": f"App {name} deleted."})
+    return web.json_response({"message": f"App {name} not found."}, status=404)
+
+
+async def handle_app_data_delete(request: web.Request) -> web.Response:
+    """(CommandClient.futureAppDataDelete, CommandClient.scala:115-135)"""
+    name = request.match_info["name"]
+
+    def work():
+        meta = Storage.get_metadata()
+        app = meta.app_get_by_name(name)
+        if app is None:
+            return False
+        events = Storage.get_events()
+        events.remove_app(app.id)
+        events.init_app(app.id)
+        return True
+
+    if await asyncio.to_thread(work):
+        return web.json_response({"status": 0, "message": f"Data of app {name} deleted."})
+    return web.json_response({"message": f"App {name} not found."}, status=404)
+
+
+def create_admin_app() -> web.Application:
+    app = web.Application()
+    app.router.add_get("/", handle_root)
+    app.router.add_get("/cmd/app", handle_app_list)
+    app.router.add_post("/cmd/app", handle_app_new)
+    app.router.add_delete("/cmd/app/{name}/data", handle_app_data_delete)
+    app.router.add_delete("/cmd/app/{name}", handle_app_delete)
+    return app
+
+
+def run_admin_server(ip: str = "127.0.0.1", port: int = 7071) -> None:
+    logging.basicConfig(level=logging.INFO)
+    log.info("Admin server starting on %s:%d", ip, port)
+    web.run_app(create_admin_app(), host=ip, port=port, print=None)

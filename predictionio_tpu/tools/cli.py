@@ -324,14 +324,16 @@ def cmd_deploy(args) -> int:
 
 
 def cmd_undeploy(args) -> int:
-    import requests
+    import urllib.error
+    import urllib.request
 
     url = f"http://{args.ip}:{args.port}/stop"
     try:
-        r = requests.get(url, timeout=5)
-        _ok(f"Undeploy requested: {r.json().get('message')}")
+        with urllib.request.urlopen(url, timeout=5) as r:
+            body = json.loads(r.read().decode())
+        _ok(f"Undeploy requested: {body.get('message')}")
         return 0
-    except Exception as e:
+    except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
         _die(f"cannot reach engine server at {url}: {e}")
     return 1
 

@@ -1,0 +1,114 @@
+"""Evaluation dashboard on :9000.
+
+Analog of reference ``Dashboard`` (tools/src/main/scala/io/prediction/
+tools/dashboard/Dashboard.scala:52-141 + CorsSupport.scala): lists
+completed evaluation instances newest-first and serves each instance's
+evaluator results as text/HTML/JSON on
+``/engine_instances/<id>/evaluator_results.{txt,html,json}``.
+"""
+
+from __future__ import annotations
+
+import html as html_mod
+import logging
+
+from aiohttp import web
+
+from ..storage import Storage
+
+log = logging.getLogger("predictionio_tpu.dashboard")
+
+__all__ = ["create_dashboard_app", "run_dashboard"]
+
+
+@web.middleware
+async def cors_middleware(request: web.Request, handler):
+    """(reference CorsSupport.scala — allow-all CORS for dashboard XHR)"""
+    if request.method == "OPTIONS":
+        resp = web.Response()
+    else:
+        resp = await handler(request)
+    resp.headers["Access-Control-Allow-Origin"] = "*"
+    resp.headers["Access-Control-Allow-Methods"] = "GET, OPTIONS"
+    resp.headers["Access-Control-Allow-Headers"] = "Content-Type"
+    return resp
+
+
+async def handle_index(request: web.Request) -> web.Response:
+    meta = Storage.get_metadata()
+    completed = meta.evaluation_instance_get_completed()
+    rows = "\n".join(
+        "<tr><td>{id}</td><td>{start}</td><td>{end}</td>"
+        "<td>{cls}</td><td>{gen}</td><td>{batch}</td>"
+        '<td><a href="/engine_instances/{id}/evaluator_results.txt">txt</a> '
+        '<a href="/engine_instances/{id}/evaluator_results.html">HTML</a> '
+        '<a href="/engine_instances/{id}/evaluator_results.json">JSON</a></td></tr>'.format(
+            id=i.id,
+            start=html_mod.escape(i.start_time.isoformat()),
+            end=html_mod.escape(i.end_time.isoformat()),
+            cls=html_mod.escape(i.evaluation_class),
+            gen=html_mod.escape(i.engine_params_generator_class),
+            batch=html_mod.escape(i.batch),
+        )
+        for i in completed
+    )
+    body = (
+        "<html><head><title>predictionio_tpu dashboard</title></head><body>"
+        "<h1>Completed evaluations</h1>"
+        "<table border=1><tr><th>ID</th><th>start</th><th>end</th>"
+        "<th>evaluation</th><th>generator</th><th>batch</th><th>results</th></tr>"
+        f"{rows}</table></body></html>"
+    )
+    return web.Response(text=body, content_type="text/html")
+
+
+def _get_instance(request: web.Request):
+    iid = request.match_info["instance_id"]
+    inst = Storage.get_metadata().evaluation_instance_get(iid)
+    if inst is None or inst.status != "EVALCOMPLETED":
+        return None
+    return inst
+
+
+async def handle_results_txt(request: web.Request) -> web.Response:
+    inst = _get_instance(request)
+    if inst is None:
+        return web.Response(status=404, text="Not Found")
+    return web.Response(text=inst.evaluator_results, content_type="text/plain")
+
+
+async def handle_results_html(request: web.Request) -> web.Response:
+    inst = _get_instance(request)
+    if inst is None:
+        return web.Response(status=404, text="Not Found")
+    return web.Response(text=inst.evaluator_results_html, content_type="text/html")
+
+
+async def handle_results_json(request: web.Request) -> web.Response:
+    inst = _get_instance(request)
+    if inst is None:
+        return web.json_response({"message": "Not Found"}, status=404)
+    return web.Response(
+        text=inst.evaluator_results_json, content_type="application/json"
+    )
+
+
+def create_dashboard_app() -> web.Application:
+    app = web.Application(middlewares=[cors_middleware])
+    app.router.add_get("/", handle_index)
+    app.router.add_get(
+        "/engine_instances/{instance_id}/evaluator_results.txt", handle_results_txt
+    )
+    app.router.add_get(
+        "/engine_instances/{instance_id}/evaluator_results.html", handle_results_html
+    )
+    app.router.add_get(
+        "/engine_instances/{instance_id}/evaluator_results.json", handle_results_json
+    )
+    return app
+
+
+def run_dashboard(ip: str = "127.0.0.1", port: int = 9000) -> None:
+    logging.basicConfig(level=logging.INFO)
+    log.info("Dashboard starting on %s:%d", ip, port)
+    web.run_app(create_dashboard_app(), host=ip, port=port, print=None)

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import threading
 import json
 import logging
 import time
@@ -90,7 +91,7 @@ class EngineServer:
         self.request_count = 0
         self.avg_serving_sec = 0.0
         self.last_serving_sec = 0.0
-        self._swap_lock = asyncio.Lock()
+        self._reload_lock = threading.Lock()  # serialize expensive reloads
 
     # -- query hot path ----------------------------------------------------
     def serve_query(self, query_json: dict) -> dict:
@@ -98,14 +99,13 @@ class EngineServer:
         bundle = self.deployed  # snapshot reference (atomic swap safety)
         result = bundle.result
         predictions = []
-        for algo, model in zip(result.algorithms, result.models):
+        first_q = query_json
+        for i, (algo, model) in enumerate(zip(result.algorithms, result.models)):
             qcls = getattr(algo, "query_class", None)
             q = parse_params(qcls, query_json) if qcls is not None else query_json
+            if i == 0:
+                first_q = q
             predictions.append(algo.predict(model, q))
-        first_q = query_json
-        qcls0 = getattr(result.algorithms[0], "query_class", None)
-        if qcls0 is not None:
-            first_q = parse_params(qcls0, query_json)
         served = result.serving.serve(first_q, predictions)
         dt = time.perf_counter() - t0
         self.request_count += 1
@@ -115,6 +115,10 @@ class EngineServer:
 
     # -- hot reload (MasterActor ReloadServer, :315-336) -------------------
     def reload_latest(self) -> str:
+        with self._reload_lock:
+            return self._reload_latest()
+
+    def _reload_latest(self) -> str:
         meta = Storage.get_metadata()
         inst = self.deployed.instance
         latest = meta.engine_instance_get_latest_completed(

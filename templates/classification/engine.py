@@ -1,0 +1,182 @@
+"""Classification engine template — NaiveBayes / LogisticRegression /
+RandomForest on event-property features.
+
+Analog of the reference's scala-parallel-classification template
+(add-algorithm variant: examples/scala-parallel-classification/
+add-algorithm/src/main/scala/{DataSource,NaiveBayesAlgorithm,
+RandomForestAlgorithm,Serving}.scala): ``$set`` events define per-user
+attributes (attr0..attrN) and a label ("plan"); all configured algorithms
+train on the same features and serving returns the first prediction.
+
+Query:  {"features": [2, 0, 0]}
+Result: {"label": 1.0}
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    Params,
+    Preparator,
+    SanityCheck,
+)
+from predictionio_tpu.models.logreg import train_logreg
+from predictionio_tpu.models.naive_bayes import train_naive_bayes
+from predictionio_tpu.models.random_forest import train_random_forest
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "MyApp"
+    attrs: tuple = ("attr0", "attr1", "attr2")
+    label: str = "plan"
+    eval_k: int = 0
+
+
+@dataclass(frozen=True)
+class Query:
+    features: tuple = ()
+
+
+@dataclass(frozen=True)
+class PredictedResult:
+    label: float = 0.0
+
+
+class LabeledPoints(SanityCheck):
+    """(the MLlib LabeledPoint RDD analog: dense columns)"""
+
+    def __init__(self, x: np.ndarray, y: np.ndarray):
+        self.x = x
+        self.y = y
+
+    def sanity_check(self) -> None:
+        if len(self.y) == 0:
+            raise ValueError("No labeled entities found; import data first.")
+
+
+class ClassificationDataSource(DataSource):
+    """Aggregates $set user properties into feature/label arrays
+    (reference DataSource.scala:13-20 readTraining -> LabeledPoint)."""
+
+    params_class = DataSourceParams
+
+    def _points(self, ctx) -> LabeledPoints:
+        store = ctx.event_store()
+        props = store.aggregate_properties(
+            app_name=self.params.app_name, entity_type="user",
+            required=[*self.params.attrs, self.params.label],
+        )
+        xs, ys = [], []
+        for _eid, pm in props.items():
+            xs.append([float(pm.get(a)) for a in self.params.attrs])
+            ys.append(float(pm.get(self.params.label)))
+        x = np.asarray(xs, np.float32).reshape(-1, len(self.params.attrs))
+        return LabeledPoints(x, np.asarray(ys))
+
+    def read_training(self, ctx) -> LabeledPoints:
+        return self._points(ctx)
+
+    def read_eval(self, ctx):
+        full = self._points(ctx)
+        k = self.params.eval_k
+        if k <= 1:
+            return []
+        idx = np.arange(len(full.y))
+        folds = []
+        for fold in range(k):
+            test = (idx % k) == fold
+            td = LabeledPoints(full.x[~test], full.y[~test])
+            qa = [
+                (Query(features=tuple(full.x[i].tolist())), float(full.y[i]))
+                for i in np.nonzero(test)[0]
+            ]
+            folds.append((td, {"fold": fold}, qa))
+        return folds
+
+
+class ClassificationPreparator(Preparator):
+    def prepare(self, ctx, td: LabeledPoints) -> LabeledPoints:
+        return td
+
+
+@dataclass(frozen=True)
+class NaiveBayesParams(Params):
+    smoothing: float = 1.0  # reference NaiveBayesAlgorithm "lambda"
+
+
+class NaiveBayesAlgorithm(Algorithm):
+    params_class = NaiveBayesParams
+    query_class = Query
+
+    def train(self, ctx, pd: LabeledPoints):
+        return train_naive_bayes(pd.x, pd.y, smoothing=self.params.smoothing,
+                                 mesh=ctx.mesh)
+
+    def predict(self, model, query: Query) -> PredictedResult:
+        x = np.asarray(query.features, np.float32)
+        return PredictedResult(label=float(model.predict(x)[0]))
+
+
+@dataclass(frozen=True)
+class LogRegParams(Params):
+    steps: int = 200
+    lr: float = 0.1
+    l2: float = 1e-4
+
+
+class LogisticRegressionAlgorithm(Algorithm):
+    params_class = LogRegParams
+    query_class = Query
+
+    def train(self, ctx, pd: LabeledPoints):
+        return train_logreg(pd.x, pd.y, steps=self.params.steps,
+                            lr=self.params.lr, l2=self.params.l2, mesh=ctx.mesh)
+
+    def predict(self, model, query: Query) -> PredictedResult:
+        x = np.atleast_2d(np.asarray(query.features, np.float32))
+        return PredictedResult(label=float(model.predict(x)[0]))
+
+
+@dataclass(frozen=True)
+class RandomForestParams(Params):
+    """(reference RandomForestAlgorithm.scala params: numTrees, maxDepth)"""
+
+    num_trees: int = 10
+    max_depth: int = 8
+    seed: int = 0
+
+
+class RandomForestAlgorithm(Algorithm):
+    params_class = RandomForestParams
+    query_class = Query
+
+    def train(self, ctx, pd: LabeledPoints):
+        return train_random_forest(
+            pd.x, pd.y, num_trees=self.params.num_trees,
+            max_depth=self.params.max_depth, seed=self.params.seed,
+        )
+
+    def predict(self, model, query: Query) -> PredictedResult:
+        x = np.atleast_2d(np.asarray(query.features, np.float64))
+        return PredictedResult(label=float(model.predict(x)[0]))
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_classes=ClassificationDataSource,
+        preparator_classes=ClassificationPreparator,
+        algorithm_classes={
+            "naive": NaiveBayesAlgorithm,
+            "logreg": LogisticRegressionAlgorithm,
+            "randomforest": RandomForestAlgorithm,
+        },
+        serving_classes=FirstServing,
+    )

@@ -1,0 +1,238 @@
+"""E-commerce recommendation template — ALS + real-time business filters.
+
+Analog of the reference's scala-parallel-ecommercerecommendation
+train-with-rate-event variant (reference: examples/scala-parallel-
+ecommercerecommendation/train-with-rate-event/src/main/scala/
+ALSAlgorithm.scala, 436 LoC): implicit ALS over view/buy events, and at
+``predict()`` time the engine queries the LIVE event store for
+
+- the user's recently seen items (ALSAlgorithm.scala:160-181),
+- the latest ``$set`` of the ``constraint/unavailableItems`` entity
+  (ALSAlgorithm.scala:194-216),
+
+merges them with the query's blackList, and serves top-N from the
+remaining candidates — so business rules take effect without retraining.
+Unseen users fall back to scoring against their recent view events'
+item factors (predictNewUser, :285).
+
+TPU note (SURVEY §7 hard part (b)): dynamic filters never reshape device
+arrays — they become boolean candidate masks over the fixed item axis.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    Params,
+    Preparator,
+    SanityCheck,
+)
+from predictionio_tpu.models.als import ALSConfig, ALSModel, train_als
+from predictionio_tpu.storage.frame import Ratings
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "MyApp"
+
+
+@dataclass(frozen=True)
+class AlgorithmParams(Params):
+    """(reference ECommAlgorithmParams: appName, unseenOnly, seenEvents,
+    similarEvents, rank, numIterations, lambda, alpha, seed)"""
+
+    app_name: str = "MyApp"
+    unseen_only: bool = True
+    seen_events: tuple = ("buy", "view")
+    rank: int = 10
+    num_iterations: int = 10
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seed: int = 3
+
+
+@dataclass(frozen=True)
+class Query:
+    user: str
+    num: int = 10
+    categories: tuple | None = None
+    whiteList: tuple | None = None
+    blackList: tuple | None = None
+
+
+@dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclass(frozen=True)
+class PredictedResult:
+    itemScores: tuple = ()
+
+
+class TrainingData(SanityCheck):
+    def __init__(self, ratings: Ratings, item_categories: dict[str, tuple]):
+        self.ratings = ratings
+        self.item_categories = item_categories
+
+    def sanity_check(self) -> None:
+        if len(self.ratings) == 0:
+            raise ValueError("No view/buy events found; import data first.")
+
+
+class ECommDataSource(DataSource):
+    params_class = DataSourceParams
+
+    def read_training(self, ctx) -> TrainingData:
+        store = ctx.event_store()
+        items = store.aggregate_properties(
+            app_name=self.params.app_name, entity_type="item"
+        )
+        item_categories = {
+            iid: tuple(pm.get_or_else("categories", []) or [])
+            for iid, pm in items.items()
+        }
+        ratings = store.find_frame(
+            app_name=self.params.app_name,
+            entity_type="user", event_names=("view", "buy"),
+            target_entity_type="item",
+        ).to_ratings(
+            # buy counts stronger than view (reference weights buy as rate-4)
+            rating_of=lambda name, props: 2.0 if name == "buy" else 1.0,
+            dedup_latest=False,
+        )
+        return TrainingData(ratings, item_categories)
+
+
+class ECommPreparator(Preparator):
+    def prepare(self, ctx, td: TrainingData) -> TrainingData:
+        return td
+
+
+class ECommModel:
+    def __init__(self, als: ALSModel, item_categories: dict[str, tuple]):
+        self.als = als
+        self.item_categories = item_categories
+
+
+class ECommAlgorithm(Algorithm):
+    params_class = AlgorithmParams
+    query_class = Query
+
+    def __init__(self, params=None):
+        super().__init__(params)
+        self._store = None  # live event-store handle, bound lazily
+
+    def train(self, ctx, td: TrainingData) -> ECommModel:
+        cfg = ALSConfig(
+            rank=self.params.rank, iterations=self.params.num_iterations,
+            lambda_=self.params.lambda_, alpha=self.params.alpha,
+            implicit_prefs=True, seed=self.params.seed,
+        )
+        return ECommModel(train_als(td.ratings, cfg, mesh=ctx.mesh),
+                          td.item_categories)
+
+    # -- live lookups (the reference's LEventStore calls at predict time) --
+    def _event_store(self):
+        if self._store is None:
+            from predictionio_tpu.store import EventStore
+
+            self._store = EventStore(default_app_name=self.params.app_name)
+        return self._store
+
+    def _seen_items(self, user: str) -> set[str]:
+        """(ALSAlgorithm.scala:160-181; limit mirrors its list size)"""
+        try:
+            events = self._event_store().find(
+                entity_type="user", entity_id=user,
+                event_names=tuple(self.params.seen_events),
+                target_entity_type="item", limit=100, latest=True,
+            )
+            return {e.target_entity_id for e in events if e.target_entity_id}
+        except Exception:
+            return set()
+
+    def _unavailable_items(self) -> set[str]:
+        """Latest $set of the constraint/unavailableItems entity
+        (ALSAlgorithm.scala:194-216)."""
+        try:
+            pm = self._event_store().aggregate_properties(
+                entity_type="constraint"
+            ).get("unavailableItems")
+            if pm is None:
+                return set()
+            return set(pm.get_or_else("items", []) or [])
+        except Exception:
+            return set()
+
+    def _candidate_mask(self, model: ECommModel, query: Query) -> np.ndarray:
+        als = model.als
+        ni = len(als.item_ids)
+        mask = np.ones(ni, bool)
+        if query.categories:
+            cats = set(query.categories)
+            for iid, row in als.item_ids.items():
+                if not (cats & set(model.item_categories.get(iid, ()))):
+                    mask[row] = False
+        if query.whiteList:
+            wl = np.zeros(ni, bool)
+            for iid in query.whiteList:
+                row = als.item_ids.get(iid)
+                if row is not None:
+                    wl[row] = True
+            mask &= wl
+        block = set(query.blackList or ())
+        block |= self._unavailable_items()
+        if self.params.unseen_only:
+            block |= self._seen_items(query.user)
+        for iid in block:
+            row = als.item_ids.get(iid)
+            if row is not None:
+                mask[row] = False
+        return mask
+
+    def predict(self, model: ECommModel, query: Query) -> PredictedResult:
+        als = model.als
+        mask = self._candidate_mask(model, query)
+        scores = als.scores_for_user(query.user)
+        if scores is None:
+            scores = self._new_user_scores(model, query)
+            if scores is None:
+                return PredictedResult()
+        scores = np.where(mask, scores, -np.inf)
+        num = min(query.num, len(scores))
+        top = np.argpartition(-scores, num - 1)[:num]
+        top = top[np.argsort(-scores[top])]
+        inv = als.item_ids.inverse
+        return PredictedResult(itemScores=tuple(
+            ItemScore(item=inv[int(i)], score=float(scores[i]))
+            for i in top if np.isfinite(scores[i])
+        ))
+
+    def _new_user_scores(self, model: ECommModel, query: Query) -> np.ndarray | None:
+        """Unseen user: average the item factors of their recent views and
+        score by similarity (predictNewUser, ALSAlgorithm.scala:285+)."""
+        als = model.als
+        recent = self._seen_items(query.user)
+        rows = [als.item_ids[i] for i in recent if i in als.item_ids]
+        if not rows:
+            return None
+        profile = als.item_factors[rows].mean(axis=0)
+        return als.item_factors @ profile
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_classes=ECommDataSource,
+        preparator_classes=ECommPreparator,
+        algorithm_classes={"ecomm": ECommAlgorithm},
+        serving_classes=FirstServing,
+    )

@@ -1,0 +1,218 @@
+"""Similar-product engine template — implicit ALS + batched cosine top-N.
+
+Analog of the reference's scala-parallel-similarproduct "multi" variant
+(reference: examples/scala-parallel-similarproduct/multi/src/main/scala/
+{DataSource,ALSAlgorithm,LikeAlgorithm,Serving}.scala): ``$set`` events
+register users and items (items carry ``categories``), "view" events feed
+an implicit-ALS item model, the multi variant adds a second algorithm over
+like/dislike events, and custom serving dedupes by item keeping the
+highest score.
+
+Query:  {"items": ["i1"], "num": 4, "categories": [...], "whiteList": [],
+         "blackList": []}
+Result: {"itemScores": [{"item": ..., "score": ...}]}
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    Params,
+    Preparator,
+    SanityCheck,
+    Serving,
+)
+from predictionio_tpu.models.als import ALSConfig, ALSModel, train_als
+from predictionio_tpu.storage.frame import Ratings
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "MyApp"
+
+
+@dataclass(frozen=True)
+class AlgorithmParams(Params):
+    """(reference ALSAlgorithm params: rank, numIterations, lambda, alpha, seed)"""
+
+    rank: int = 10
+    num_iterations: int = 10
+    lambda_: float = 0.01
+    alpha: float = 1.0
+    seed: int = 3
+
+
+@dataclass(frozen=True)
+class Query:
+    items: tuple = ()
+    num: int = 10
+    categories: tuple | None = None
+    whiteList: tuple | None = None
+    blackList: tuple | None = None
+
+
+@dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclass(frozen=True)
+class PredictedResult:
+    itemScores: tuple = ()
+
+
+class TrainingData(SanityCheck):
+    def __init__(self, view_ratings: Ratings, like_ratings: Ratings,
+                 item_categories: dict[str, tuple]):
+        self.view_ratings = view_ratings
+        self.like_ratings = like_ratings
+        self.item_categories = item_categories
+
+    def sanity_check(self) -> None:
+        if len(self.view_ratings) == 0 and len(self.like_ratings) == 0:
+            raise ValueError("No view/like events found; import data first.")
+
+
+class SimilarProductDataSource(DataSource):
+    """(reference DataSource.scala: users/items via $set aggregation,
+    viewEvents + likeEvents streams)"""
+
+    params_class = DataSourceParams
+
+    def read_training(self, ctx) -> TrainingData:
+        store = ctx.event_store()
+        items = store.aggregate_properties(
+            app_name=self.params.app_name, entity_type="item"
+        )
+        item_categories = {
+            iid: tuple(pm.get_or_else("categories", []) or [])
+            for iid, pm in items.items()
+        }
+        views = store.find_frame(
+            app_name=self.params.app_name,
+            entity_type="user", event_names=("view",),
+            target_entity_type="item",
+        ).to_ratings(rating_of=lambda name, props: 1.0)
+        likes = store.find_frame(
+            app_name=self.params.app_name,
+            entity_type="user", event_names=("like", "dislike"),
+            target_entity_type="item",
+        ).to_ratings(
+            # like=1, dislike skipped (reference LikeAlgorithm keeps the
+            # LATEST like/dislike per pair; dedup_latest handles that, and
+            # dislikes train as weight 0 via None -> skip)
+            rating_of=lambda name, props: 1.0 if name == "like" else None
+        )
+        return TrainingData(views, likes, item_categories)
+
+
+class SimilarProductPreparator(Preparator):
+    def prepare(self, ctx, td: TrainingData) -> TrainingData:
+        return td
+
+
+class _CosineModel:
+    """ALSModel + category metadata for candidate filtering."""
+
+    def __init__(self, als: ALSModel, item_categories: dict[str, tuple]):
+        self.als = als
+        self.item_categories = item_categories
+
+    def query_rows(self, item_ids) -> list[int]:
+        rows = [self.als.item_ids.get(i) for i in item_ids]
+        return [r for r in rows if r is not None]
+
+    def candidate_mask(self, query: Query) -> np.ndarray | None:
+        ni = len(self.als.item_ids)
+        mask = None
+        if query.categories:
+            mask = np.zeros(ni, bool)
+            cats = set(query.categories)
+            for iid, row in self.als.item_ids.items():
+                if cats & set(self.item_categories.get(iid, ())):
+                    mask[row] = True
+        if query.whiteList:
+            wl = np.zeros(ni, bool)
+            for iid in query.whiteList:
+                row = self.als.item_ids.get(iid)
+                if row is not None:
+                    wl[row] = True
+            mask = wl if mask is None else (mask & wl)
+        if query.blackList:
+            bl = np.ones(ni, bool)
+            for iid in query.blackList:
+                row = self.als.item_ids.get(iid)
+                if row is not None:
+                    bl[row] = False
+            mask = bl if mask is None else (mask & bl)
+        return mask
+
+    def similar(self, query: Query) -> tuple:
+        rows = self.query_rows(query.items)
+        if not rows:
+            return ()
+        sims = self.als.similar_items(rows, query.num,
+                                      candidate_mask=self.candidate_mask(query))
+        inv = self.als.item_ids.inverse
+        return tuple(ItemScore(item=inv[r], score=s) for r, s in sims)
+
+
+class _BaseSimilarAlgorithm(Algorithm):
+    params_class = AlgorithmParams
+    query_class = Query
+
+    def _train_on(self, ctx, ratings: Ratings, categories) -> _CosineModel:
+        cfg = ALSConfig(
+            rank=self.params.rank, iterations=self.params.num_iterations,
+            lambda_=self.params.lambda_, alpha=self.params.alpha,
+            implicit_prefs=True, seed=self.params.seed,
+        )
+        return _CosineModel(train_als(ratings, cfg, mesh=ctx.mesh), categories)
+
+    def predict(self, model: _CosineModel, query: Query) -> PredictedResult:
+        return PredictedResult(itemScores=model.similar(query))
+
+
+class ALSAlgorithm(_BaseSimilarAlgorithm):
+    """Implicit ALS over view events (reference ALSAlgorithm.scala:130)."""
+
+    def train(self, ctx, td: TrainingData) -> _CosineModel:
+        return self._train_on(ctx, td.view_ratings, td.item_categories)
+
+
+class LikeAlgorithm(_BaseSimilarAlgorithm):
+    """Same model over like events (reference LikeAlgorithm.scala)."""
+
+    def train(self, ctx, td: TrainingData) -> _CosineModel:
+        return self._train_on(ctx, td.like_ratings, td.item_categories)
+
+
+class DedupeServing(Serving):
+    """Multi-algorithm combine: aggregate scores per item, top-N overall
+    (reference multi/Serving.scala sums scores of duplicate items)."""
+
+    def serve(self, query: Query, predictions) -> PredictedResult:
+        agg: dict[str, float] = {}
+        for p in predictions:
+            for isc in p.itemScores:
+                agg[isc.item] = agg.get(isc.item, 0.0) + isc.score
+        top = sorted(agg.items(), key=lambda kv: -kv[1])[: query.num]
+        return PredictedResult(
+            itemScores=tuple(ItemScore(item=i, score=s) for i, s in top)
+        )
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_classes=SimilarProductDataSource,
+        preparator_classes=SimilarProductPreparator,
+        algorithm_classes={"als": ALSAlgorithm, "likealgo": LikeAlgorithm},
+        serving_classes=DedupeServing,
+    )

@@ -1,0 +1,123 @@
+"""Two-tower neural retrieval engine template.
+
+The drop-in neural Algorithm for the recommendation pipeline
+(BASELINE.json config 5) — same event schema and query/result shapes as
+the ALS recommendation template, so the two are interchangeable engine
+variants.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    Params,
+    Preparator,
+    SanityCheck,
+)
+from predictionio_tpu.models.two_tower import (
+    TwoTowerConfig,
+    TwoTowerModel,
+    train_two_tower,
+)
+from predictionio_tpu.storage.frame import Ratings
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "MyApp"
+
+
+@dataclass(frozen=True)
+class AlgorithmParams(Params):
+    embed_dim: int = 64
+    hidden_dim: int = 128
+    out_dim: int = 32
+    batch_size: int = 1024
+    epochs: int = 5
+    lr: float = 1e-3
+    temperature: float = 0.1
+    seed: int = 0
+
+
+@dataclass(frozen=True)
+class Query:
+    user: str
+    num: int = 10
+
+
+@dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclass(frozen=True)
+class PredictedResult:
+    itemScores: tuple = ()
+
+
+class TrainingData(SanityCheck):
+    def __init__(self, ratings: Ratings):
+        self.ratings = ratings
+
+    def sanity_check(self) -> None:
+        if len(self.ratings) == 0:
+            raise ValueError("No interaction events found; import data first.")
+
+
+class TwoTowerDataSource(DataSource):
+    params_class = DataSourceParams
+
+    def read_training(self, ctx) -> TrainingData:
+        store = ctx.event_store()
+        frame = store.find_frame(
+            app_name=self.params.app_name,
+            entity_type="user",
+            event_names=("view", "rate", "buy", "like"),
+            target_entity_type="item",
+        )
+        return TrainingData(frame.to_ratings(rating_of=lambda n, p: 1.0,
+                                             dedup_latest=False))
+
+
+class TwoTowerPreparator(Preparator):
+    def prepare(self, ctx, td: TrainingData) -> Ratings:
+        return td.ratings
+
+
+class TwoTowerAlgorithm(Algorithm):
+    params_class = AlgorithmParams
+    query_class = Query
+
+    def train(self, ctx, ratings: Ratings) -> TwoTowerModel:
+        cfg = TwoTowerConfig(
+            embed_dim=self.params.embed_dim,
+            hidden_dim=self.params.hidden_dim,
+            out_dim=self.params.out_dim,
+            batch_size=self.params.batch_size,
+            epochs=self.params.epochs,
+            lr=self.params.lr,
+            temperature=self.params.temperature,
+            seed=self.params.seed,
+        )
+        return train_two_tower(ratings, cfg, mesh=ctx.mesh)
+
+    def predict(self, model: TwoTowerModel, query: Query) -> PredictedResult:
+        recs = model.recommend_products(query.user, query.num)
+        return PredictedResult(
+            itemScores=tuple(ItemScore(item=i, score=s) for i, s in recs)
+        )
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_classes=TwoTowerDataSource,
+        preparator_classes=TwoTowerPreparator,
+        algorithm_classes={"twotower": TwoTowerAlgorithm},
+        serving_classes=FirstServing,
+    )

@@ -1,0 +1,124 @@
+"""engine_lib (e2 analog) — mirrors reference CategoricalNaiveBayesTest
+(e2/src/test/.../CategoricalNaiveBayesTest.scala:1-132), MarkovChainTest,
+CrossValidationTest."""
+
+import math
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.engine_lib import (
+    split_data,
+    train_categorical_nb,
+    train_markov_chain,
+)
+from predictionio_tpu.engine_lib.categorical_nb import LabeledPoint
+
+
+def points():
+    return [
+        LabeledPoint("spam", ("cheap", "pills")),
+        LabeledPoint("spam", ("cheap", "watches")),
+        LabeledPoint("spam", ("cheap", "pills")),
+        LabeledPoint("ham", ("meeting", "notes")),
+        LabeledPoint("ham", ("cheap", "notes")),
+    ]
+
+
+class TestCategoricalNB:
+    def test_priors_and_likelihoods(self):
+        model = train_categorical_nb(points())
+        assert math.isclose(model.priors["spam"], math.log(3 / 5))
+        assert math.isclose(model.priors["ham"], math.log(2 / 5))
+        # P(pills | spam, pos=1) = 2/3
+        assert math.isclose(model.likelihoods["spam"][1]["pills"], math.log(2 / 3))
+
+    def test_log_score(self):
+        model = train_categorical_nb(points())
+        s = model.log_score(LabeledPoint("spam", ("cheap", "pills")))
+        assert math.isclose(s, math.log(3 / 5) + math.log(1.0) + math.log(2 / 3))
+        # unseen value without default -> None
+        assert model.log_score(LabeledPoint("spam", ("cheap", "zzz"))) is None
+        # with default
+        s = model.log_score(
+            LabeledPoint("spam", ("cheap", "zzz")), default_likelihood=lambda lls: -10
+        )
+        assert s is not None and s < -9
+        # unknown label -> None
+        assert model.log_score(LabeledPoint("nope", ("cheap", "pills"))) is None
+
+    def test_predict(self):
+        model = train_categorical_nb(points())
+        assert model.predict(("cheap", "pills")) == "spam"
+        assert model.predict(("meeting", "notes")) == "ham"
+
+    def test_arity_mismatch(self):
+        model = train_categorical_nb(points())
+        with pytest.raises(ValueError):
+            model.log_score(LabeledPoint("spam", ("only-one",)))
+
+
+class TestMarkovChain:
+    def test_topn_normalized(self):
+        # state 0 -> {1: 6, 2: 3, 3: 1}; topN=2 keeps 1 and 2
+        model = train_markov_chain(
+            np.array([0, 0, 0, 1]), np.array([1, 2, 3, 0]),
+            np.array([6.0, 3.0, 1.0, 5.0]), n_states=4, top_n=2,
+        )
+        pred = model.predict(0)
+        assert [c for c, _ in pred] == [1, 2]
+        assert math.isclose(pred[0][1], 0.6)
+        assert math.isclose(pred[1][1], 0.3)
+        # state with no outgoing transitions -> empty
+        assert model.predict(3) == []
+        with pytest.raises(IndexError):
+            model.predict(9)
+
+
+class TestCrossValidation:
+    def test_split(self):
+        data = list(range(10))
+        folds = split_data(3, data, lambda x: (f"q{x}", x))
+        assert len(folds) == 3
+        for k, (train, info, test) in enumerate(folds):
+            assert info == {"fold": k}
+            test_vals = [a for _q, a in test]
+            assert test_vals == [x for x in data if x % 3 == k]
+            assert sorted(train + test_vals) == data
+
+    def test_k_too_small(self):
+        with pytest.raises(ValueError):
+            split_data(1, [1, 2], lambda x: (x, x))
+
+
+def test_two_tower_learns_structure(rng, mesh8):
+    from predictionio_tpu.models.two_tower import TwoTowerConfig, train_two_tower
+    from predictionio_tpu.storage.bimap import BiMap
+    from predictionio_tpu.storage.frame import Ratings
+
+    # two disjoint cohorts
+    nu, ni = 32, 16
+    rows, cols = [], []
+    for u in range(nu):
+        for i in range(ni):
+            if (u % 2) == (i % 2) and rng.random() < 0.9:
+                rows.append(u)
+                cols.append(i)
+    ratings = Ratings(
+        user_indices=np.asarray(rows, np.int32),
+        item_indices=np.asarray(cols, np.int32),
+        ratings=np.ones(len(rows), np.float32),
+        user_ids=BiMap({f"u{i}": i for i in range(nu)}),
+        item_ids=BiMap({f"i{j}": j for j in range(ni)}),
+    )
+    cfg = TwoTowerConfig(embed_dim=16, hidden_dim=32, out_dim=8,
+                         batch_size=64, epochs=30, lr=5e-3)
+    model = train_two_tower(ratings, cfg, mesh=mesh8)
+    # top recommendations should match the user's cohort parity
+    hits = 0
+    for u in ("u0", "u1", "u2", "u3"):
+        recs = model.recommend_products(u, 4)
+        parity = int(u[1:]) % 2
+        hits += sum(1 for iid, _ in recs if int(iid[1:]) % 2 == parity)
+    assert hits >= 10, f"only {hits}/16 cohort-consistent recommendations"
+    assert model.recommend_products("ghost", 3) == []

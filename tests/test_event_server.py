@@ -252,3 +252,19 @@ def test_webhook_mailchimp_form(server, app_key):
         data={"type": "subscribe"},
     )
     assert r.status_code == 400
+
+
+def test_access_key_event_whitelist(server, app_key):
+    """Keys restricted to specific events reject others with 403."""
+    app, _ = app_key
+    meta = Storage.get_metadata()
+    restricted = meta.access_key_insert(app.id, events=("view",))
+    ok = requests.post(
+        f"{server.url}/events.json?accessKey={restricted.key}",
+        json=dict(EV, event="view"),
+    )
+    assert ok.status_code == 201
+    denied = requests.post(
+        f"{server.url}/events.json?accessKey={restricted.key}", json=EV
+    )
+    assert denied.status_code == 403

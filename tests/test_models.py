@@ -1,0 +1,55 @@
+"""Classification models: NaiveBayes, logistic regression, random forest."""
+
+import numpy as np
+
+from predictionio_tpu.models.logreg import train_logreg
+from predictionio_tpu.models.naive_bayes import train_naive_bayes
+from predictionio_tpu.models.random_forest import train_random_forest
+
+
+def separable_data(rng, n=240, f=4):
+    """3 classes with distinct count profiles."""
+    y = rng.integers(0, 3, n)
+    centers = np.array([[5, 1, 1, 1], [1, 5, 1, 1], [1, 1, 5, 2]], np.float64)
+    x = rng.poisson(centers[y]).astype(np.float32)
+    return x, y.astype(np.float64)
+
+
+def test_naive_bayes_accuracy(rng, mesh8):
+    x, y = separable_data(rng)
+    model = train_naive_bayes(x, y, mesh=mesh8)
+    acc = (model.predict(x) == y).mean()
+    assert acc > 0.85
+    # labels preserved as original values
+    assert set(model.labels) == {0.0, 1.0, 2.0}
+
+
+def test_naive_bayes_single_sample(rng, mesh8):
+    x, y = separable_data(rng, n=60)
+    model = train_naive_bayes(x, y, mesh=mesh8)
+    pred = model.predict(x[0])
+    assert pred.shape == (1,)
+
+
+def test_logreg_accuracy(rng, mesh8):
+    x, y = separable_data(rng)
+    model = train_logreg(x, y, steps=300, lr=0.2, mesh=mesh8)
+    acc = (model.predict(x) == y).mean()
+    assert acc > 0.85
+    proba = model.predict_proba(x[:5])
+    assert np.allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_random_forest_accuracy(rng):
+    x, y = separable_data(rng)
+    model = train_random_forest(x, y, num_trees=15, max_depth=6, seed=1)
+    acc = (model.predict(x) == y).mean()
+    assert acc > 0.9  # forests overfit training data; this checks wiring
+
+
+def test_random_forest_constant_feature(rng):
+    """Unsplittable features do not crash induction."""
+    x = np.ones((50, 3))
+    y = (np.arange(50) % 2).astype(float)
+    model = train_random_forest(x, y, num_trees=3)
+    assert model.predict(x).shape == (50,)

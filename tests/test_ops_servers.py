@@ -1,0 +1,94 @@
+"""Dashboard + admin API — mirrors reference AdminAPISpec
+(tools/src/test/.../admin/AdminAPISpec.scala:1-66) plus dashboard routes."""
+
+import requests
+
+from predictionio_tpu.controller import AverageMetric, EngineParams, Evaluation
+from predictionio_tpu.storage import Storage
+from predictionio_tpu.testing.sample_engine import (
+    SampleAlgoParams,
+    SampleDataSourceParams,
+    make_sample_engine,
+)
+from predictionio_tpu.tools.admin import create_admin_app
+from predictionio_tpu.tools.dashboard import create_dashboard_app
+from predictionio_tpu.workflow import run_evaluation
+from tests.helpers import ServerThread
+
+
+class _M(AverageMetric):
+    def calculate_qpa(self, q, p, a):
+        return float(p.value)
+
+
+def _run_one_eval():
+    engine = make_sample_engine()
+
+    class Ev(Evaluation):
+        pass
+
+    Ev.engine = engine
+    Ev.metric = _M()
+    grid = [
+        EngineParams(
+            data_source_params=("", SampleDataSourceParams(id=1, n_folds=1)),
+            algorithm_params_list=(("sample", SampleAlgoParams(id=1)),),
+        )
+    ]
+    iid, _ = run_evaluation(Ev(), grid, evaluation_class="Ev", batch="b1")
+    return iid
+
+
+def test_dashboard_lists_and_serves_results():
+    iid = _run_one_eval()
+    st = ServerThread(create_dashboard_app)
+    try:
+        r = requests.get(st.url + "/")
+        assert r.status_code == 200
+        assert iid in r.text and "Completed evaluations" in r.text
+        r = requests.get(f"{st.url}/engine_instances/{iid}/evaluator_results.json")
+        assert r.status_code == 200
+        assert "bestEngineParams" in r.json()
+        r = requests.get(f"{st.url}/engine_instances/{iid}/evaluator_results.html")
+        assert r.status_code == 200 and "<table" in r.text
+        r = requests.get(f"{st.url}/engine_instances/{iid}/evaluator_results.txt")
+        assert r.status_code == 200
+        r = requests.get(f"{st.url}/engine_instances/nope/evaluator_results.txt")
+        assert r.status_code == 404
+        # CORS headers present
+        r = requests.options(st.url + "/")
+        assert r.headers["Access-Control-Allow-Origin"] == "*"
+    finally:
+        st.stop()
+
+
+def test_admin_app_crud():
+    st = ServerThread(create_admin_app)
+    try:
+        assert requests.get(st.url + "/").json() == {"status": "alive"}
+        # create
+        r = requests.post(st.url + "/cmd/app", json={"name": "adminapp"})
+        assert r.status_code == 201
+        body = r.json()
+        assert body["name"] == "adminapp" and body["key"]
+        # duplicate -> 409
+        r = requests.post(st.url + "/cmd/app", json={"name": "adminapp"})
+        assert r.status_code == 409
+        # missing name -> 400
+        r = requests.post(st.url + "/cmd/app", json={})
+        assert r.status_code == 400
+        # list
+        r = requests.get(st.url + "/cmd/app")
+        apps = r.json()["apps"]
+        assert any(a["name"] == "adminapp" and a["accessKeys"] for a in apps)
+        # data delete
+        r = requests.delete(st.url + "/cmd/app/adminapp/data")
+        assert r.status_code == 200
+        # app delete
+        r = requests.delete(st.url + "/cmd/app/adminapp")
+        assert r.status_code == 200
+        assert Storage.get_metadata().app_get_by_name("adminapp") is None
+        r = requests.delete(st.url + "/cmd/app/adminapp")
+        assert r.status_code == 404
+    finally:
+        st.stop()

@@ -1,0 +1,186 @@
+"""Template engines: classification, similarproduct, ecommerce — trained
+against the in-memory event store, predictions verified including the
+serving-time business filters (the reference's judge-checked workloads,
+SURVEY §2.8)."""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.controller import EngineParams
+from predictionio_tpu.storage import DataMap, Event, Storage
+from predictionio_tpu.workflow import Context
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def load_template(name):
+    spec = importlib.util.spec_from_file_location(
+        f"tmpl_{name}", REPO / "templates" / name / "engine.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[f"tmpl_{name}"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def setup_app(name="MyApp"):
+    meta = Storage.get_metadata()
+    app = meta.app_insert(name)
+    Storage.get_events().init_app(app.id)
+    return app
+
+
+def insert(app_id, **kw):
+    props = kw.pop("props", None)
+    e = Event(properties=DataMap(props or {}), **kw)
+    Storage.get_events().insert(e, app_id)
+
+
+class TestClassification:
+    def test_train_and_predict(self, rng, mesh8):
+        mod = load_template("classification")
+        app = setup_app()
+        # two separable classes via attr profile
+        for i in range(60):
+            label = i % 2
+            attrs = {
+                "attr0": float(rng.poisson(5 if label else 1)),
+                "attr1": float(rng.poisson(1 if label else 5)),
+                "attr2": float(rng.poisson(2)),
+                "plan": float(label),
+            }
+            insert(app.id, event="$set", entity_type="user",
+                   entity_id=f"u{i}", props=attrs)
+        engine = mod.engine_factory()
+        ep = EngineParams(
+            data_source_params=("", mod.DataSourceParams(app_name="MyApp")),
+            algorithm_params_list=(
+                ("naive", mod.NaiveBayesParams()),
+                ("logreg", mod.LogRegParams(steps=150)),
+                ("randomforest", mod.RandomForestParams(num_trees=5)),
+            ),
+        )
+        result = engine.train(Context(), ep)
+        assert len(result.models) == 3
+        q1 = mod.Query(features=(6.0, 0.0, 2.0))  # class-1 profile
+        q0 = mod.Query(features=(0.0, 6.0, 2.0))  # class-0 profile
+        for algo, model in zip(result.algorithms, result.models):
+            assert algo.predict(model, q1).label == 1.0, type(algo).__name__
+            assert algo.predict(model, q0).label == 0.0, type(algo).__name__
+
+
+class TestSimilarProduct:
+    def _ingest(self, rng, app):
+        # items with categories
+        for i in range(12):
+            insert(app.id, event="$set", entity_type="item", entity_id=f"i{i}",
+                   props={"categories": ["even" if i % 2 == 0 else "odd"]})
+        # two cohorts: users view even items or odd items
+        for u in range(30):
+            parity = u % 2
+            for i in range(12):
+                if i % 2 == parity and rng.random() < 0.8:
+                    insert(app.id, event="view", entity_type="user",
+                           entity_id=f"u{u}", target_entity_type="item",
+                           target_entity_id=f"i{i}")
+        # likes reinforce the same structure
+        for u in range(0, 30, 3):
+            parity = u % 2
+            insert(app.id, event="like", entity_type="user", entity_id=f"u{u}",
+                   target_entity_type="item", target_entity_id=f"i{parity}")
+
+    def test_similar_items_with_filters(self, rng, mesh8):
+        mod = load_template("similarproduct")
+        app = setup_app()
+        self._ingest(rng, app)
+        engine = mod.engine_factory()
+        ep = EngineParams(
+            data_source_params=("", mod.DataSourceParams(app_name="MyApp")),
+            algorithm_params_list=(
+                ("als", mod.AlgorithmParams(rank=4, num_iterations=8, alpha=10.0)),
+                ("likealgo", mod.AlgorithmParams(rank=4, num_iterations=8, alpha=10.0)),
+            ),
+        )
+        result = engine.train(Context(), ep)
+        assert len(result.models) == 2
+
+        def serve(q):
+            preds = [a.predict(m, q) for a, m in zip(result.algorithms, result.models)]
+            return result.serving.serve(q, preds)
+
+        out = serve(mod.Query(items=("i0",), num=4))
+        assert 1 <= len(out.itemScores) <= 4
+        assert "i0" not in [s.item for s in out.itemScores]
+        # co-viewed parity should dominate similarity
+        evens = [s for s in out.itemScores if int(s.item[1:]) % 2 == 0]
+        assert len(evens) >= len(out.itemScores) / 2
+
+        # category filter
+        out = serve(mod.Query(items=("i0",), num=6, categories=("odd",)))
+        assert all(int(s.item[1:]) % 2 == 1 for s in out.itemScores)
+        # black list
+        out = serve(mod.Query(items=("i0",), num=6, blackList=("i2", "i4")))
+        assert not {"i2", "i4"} & {s.item for s in out.itemScores}
+        # white list
+        out = serve(mod.Query(items=("i0",), num=6, whiteList=("i6",)))
+        assert [s.item for s in out.itemScores] == ["i6"]
+        # unknown query item -> empty
+        out = serve(mod.Query(items=("nope",), num=3))
+        assert out.itemScores == ()
+
+
+class TestECommerce:
+    def _ingest(self, rng, app):
+        for i in range(10):
+            insert(app.id, event="$set", entity_type="item", entity_id=f"i{i}",
+                   props={"categories": ["c1"]})
+        for u in range(20):
+            for i in range(10):
+                if (u + i) % 3 == 0:
+                    insert(app.id, event="view", entity_type="user",
+                           entity_id=f"u{u}", target_entity_type="item",
+                           target_entity_id=f"i{i}")
+                if (u + i) % 5 == 0:
+                    insert(app.id, event="buy", entity_type="user",
+                           entity_id=f"u{u}", target_entity_type="item",
+                           target_entity_id=f"i{i}")
+
+    def test_realtime_filters(self, rng, mesh8):
+        mod = load_template("ecommercerecommendation")
+        app = setup_app()
+        self._ingest(rng, app)
+        engine = mod.engine_factory()
+        ep = EngineParams(
+            data_source_params=("", mod.DataSourceParams(app_name="MyApp")),
+            algorithm_params_list=(
+                ("ecomm", mod.AlgorithmParams(app_name="MyApp", rank=4,
+                                              num_iterations=6, unseen_only=True)),
+            ),
+        )
+        result = engine.train(Context(), ep)
+        algo, model = result.algorithms[0], result.models[0]
+
+        # unseen-only: u0's seen items (views+buys) are excluded
+        out = algo.predict(model, mod.Query(user="u0", num=10))
+        seen_u0 = {f"i{i}" for i in range(10) if i % 3 == 0 or i % 5 == 0}
+        assert not seen_u0 & {s.item for s in out.itemScores}
+        assert out.itemScores  # still recommends something
+
+        # $set constraint/unavailableItems takes effect WITHOUT retraining
+        insert(app.id, event="$set", entity_type="constraint",
+               entity_id="unavailableItems", props={"items": ["i1", "i7"]})
+        out = algo.predict(model, mod.Query(user="u0", num=10))
+        assert not {"i1", "i7"} & {s.item for s in out.itemScores}
+
+        # unseen user with recent views -> profile fallback
+        insert(app.id, event="view", entity_type="user", entity_id="brandnew",
+               target_entity_type="item", target_entity_id="i2")
+        out = algo.predict(model, mod.Query(user="brandnew", num=3))
+        assert out.itemScores
+        # totally unknown user -> empty
+        out = algo.predict(model, mod.Query(user="ghost", num=3))
+        assert out.itemScores == ()
