@@ -1,0 +1,213 @@
+"""Sequence/context parallelism: ring attention + Ulysses all-to-all.
+
+Long-sequence scaling is first-class in this framework even though the
+reference has no sequence models at all (SURVEY.md §5 "long-context":
+its longest sequence is an event iterator folded into a PropertyMap,
+reference data/.../storage/LEventAggregator.scala:68-110). The TPU-native
+sequence path shards user event histories over a ``seq`` mesh axis so
+attention over arbitrarily long histories never materializes the full
+[L, L] score matrix on one chip:
+
+- **Ring attention** (`ring_attention`): K/V blocks rotate around the ring
+  via ``ppermute`` while each device keeps its Q block; softmax is
+  accumulated flash-style (running max + denominator), so memory per chip
+  is O(L_local^2) and the K/V transfer overlaps with the block matmul.
+  Communication = (n-1) ppermute hops of the local K/V block over ICI.
+- **Ulysses** (`ulysses_attention`): ``all_to_all`` reshards seq->heads,
+  runs exact local attention per head group over the *full* sequence, and
+  reshards back. Communication = 2 all_to_alls; best when heads >= axis.
+
+Both are exact (not approximations) and match single-device attention to
+float tolerance; see tests/test_parallel_seq.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+__all__ = [
+    "blockwise_attention",
+    "ring_attention",
+    "ring_self_attention",
+    "ulysses_attention",
+]
+
+_NEG = -1e30
+
+
+def _block_attn(q, k, v, scale, mask):
+    """One [Lq, Lk] score block -> (scores_max, exp-weights @ v, exp-sum).
+
+    q: [B, Lq, H, D]; k, v: [B, Lk, H, D]; mask: [Lq, Lk] bool or None.
+    Returns (m, pv, l): m [B, H, Lq], pv [B, Lq, H, D], l [B, H, Lq].
+    """
+    import jax.numpy as jnp
+
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # [B, H, Lq, Lk]
+    if mask is not None:
+        s = jnp.where(mask[None, None, :, :], s, _NEG)
+    m = jnp.max(s, axis=-1)  # [B, H, Lq]
+    p = jnp.exp(s - m[..., None])  # [B, H, Lq, Lk]
+    # zero out fully-masked rows (exp(_NEG - _NEG) = 1 garbage)
+    p = jnp.where((m > _NEG / 2)[..., None], p, 0.0)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    l = jnp.sum(p, axis=-1)  # noqa: E741
+    return m, pv, l
+
+
+def ring_attention(q, k, v, axis_name: str = "seq", *, causal: bool = False):
+    """Exact attention with Q resident and K/V ring-rotating over
+    ``axis_name``. Must run inside shard_map (or pmap) with the sequence
+    dimension sharded over ``axis_name``.
+
+    q, k, v: [B, L_local, H, D] per-device blocks of a global [B, L, H, D].
+    Causal masking uses *global* positions: device p's Q block covers
+    positions [p*L_local, (p+1)*L_local).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    B, Lq, H, D = q.shape
+    Lk = k.shape[1]
+    scale = 1.0 / (D**0.5)
+    n = jax.lax.psum(1, axis_name)
+    p_idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    q_pos = p_idx * Lq + jnp.arange(Lq)  # global positions of our queries
+
+    def body(i, carry):
+        k_blk, v_blk, m, acc, l = carry  # noqa: E741
+        # the block we hold at step i originated on device (p_idx - i) mod n
+        src = (p_idx - i) % n
+        if causal:
+            k_pos = src * Lk + jnp.arange(Lk)
+            mask = k_pos[None, :] <= q_pos[:, None]
+        else:
+            mask = None
+        bm, bpv, bl = _block_attn(q, k_blk, v_blk, scale, mask)
+        m_new = jnp.maximum(m, bm)
+        # rescale both accumulators to the new max; guard all-masked rows
+        alpha = jnp.exp(jnp.where(m > _NEG / 2, m - m_new, 0.0))
+        beta = jnp.exp(jnp.where(bm > _NEG / 2, bm - m_new, 0.0))
+        acc = acc * alpha.transpose(0, 2, 1)[..., None] + bpv * beta.transpose(0, 2, 1)[..., None]
+        l = l * alpha + bl * beta  # noqa: E741
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return k_blk, v_blk, m_new, acc, l
+
+    m0 = jnp.full((B, H, Lq), _NEG, q.dtype)
+    acc0 = jnp.zeros((B, Lq, H, D), q.dtype)
+    l0 = jnp.zeros((B, H, Lq), q.dtype)
+    _, _, _, acc, l = jax.lax.fori_loop(  # noqa: E741
+        0, n, body, (k, v, m0, acc0, l0)
+    )
+    denom = jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
+    return acc / denom
+
+
+def blockwise_attention(q, k, v, *, causal: bool = False, block_size: int = 512):
+    """Single-device flash-style blockwise attention over K/V chunks —
+    the n=1 degenerate case of the ring, used when no ``seq`` axis exists.
+    q, k, v: [B, L, H, D]."""
+    import jax
+    import jax.numpy as jnp
+
+    B, L, H, D = q.shape
+    scale = 1.0 / (D**0.5)
+    nblk = max(1, (L + block_size - 1) // block_size)
+    if L % nblk:
+        raise ValueError(f"L={L} not divisible into {nblk} blocks")
+    bs = L // nblk
+    q_pos = jnp.arange(L)
+    kr = k.reshape(B, nblk, bs, H, D)
+    vr = v.reshape(B, nblk, bs, H, D)
+
+    def body(i, carry):
+        m, acc, l = carry  # noqa: E741
+        k_blk = jax.lax.dynamic_index_in_dim(kr, i, 1, keepdims=False)
+        v_blk = jax.lax.dynamic_index_in_dim(vr, i, 1, keepdims=False)
+        if causal:
+            k_pos = i * bs + jnp.arange(bs)
+            mask = k_pos[None, :] <= q_pos[:, None]
+        else:
+            mask = None
+        bm, bpv, bl = _block_attn(q, k_blk, v_blk, scale, mask)
+        m_new = jnp.maximum(m, bm)
+        alpha = jnp.exp(jnp.where(m > _NEG / 2, m - m_new, 0.0))
+        beta = jnp.exp(jnp.where(bm > _NEG / 2, bm - m_new, 0.0))
+        acc = acc * alpha.transpose(0, 2, 1)[..., None] + bpv * beta.transpose(0, 2, 1)[..., None]
+        l = l * alpha + bl * beta  # noqa: E741
+        return m_new, acc, l
+
+    m0 = jnp.full((B, H, L), _NEG, q.dtype)
+    acc0 = jnp.zeros((B, L, H, D), q.dtype)
+    l0 = jnp.zeros((B, H, L), q.dtype)
+    _, acc, l = jax.lax.fori_loop(0, nblk, body, (m0, acc0, l0))  # noqa: E741
+    denom = jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
+    return acc / denom
+
+
+def ring_self_attention(mesh, q, k, v, *, causal: bool = False,
+                        seq_axis: str = "seq", batch_axis: str | None = "data"):
+    """Top-level entry: shard [B, L, H, D] arrays over (batch, seq) mesh
+    axes and run ring attention. Returns the output with the same
+    sharding. Falls back to blockwise single-device attention when the
+    mesh lacks ``seq_axis``."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .collectives import get_shard_map
+
+    shard_map = get_shard_map()
+
+    if seq_axis not in mesh.shape or mesh.shape[seq_axis] == 1:
+        return blockwise_attention(q, k, v, causal=causal,
+                                   block_size=max(1, q.shape[1]))
+    b_ax = batch_axis if (batch_axis and batch_axis in mesh.shape) else None
+    spec = P(b_ax, seq_axis, None, None)
+    fn = shard_map(
+        partial(ring_attention, axis_name=seq_axis, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False,
+    )
+    # with_sharding_constraint works both eagerly and under jit traces,
+    # so the same code path serves the deploy server and compiled train steps
+    sh = NamedSharding(mesh, spec)
+    q, k, v = (jax.lax.with_sharding_constraint(x, sh) for x in (q, k, v))
+    return fn(q, k, v)
+
+
+def ulysses_attention(q, k, v, axis_name: str = "seq", *, causal: bool = False):
+    """All-to-all sequence parallelism (DeepSpeed-Ulysses style): reshard
+    seq-sharded [B, L_local, H, D] into head-sharded [B, L, H/n, D], run
+    exact attention on the full sequence locally, reshard back. Must run
+    inside shard_map with seq dim sharded over ``axis_name``; H must be
+    divisible by the axis size."""
+    import jax
+    import jax.numpy as jnp
+
+    n = jax.lax.psum(1, axis_name)
+    H = q.shape[2]
+
+    def seq_to_heads(x):
+        # [B, Ll, H, D] -> [B, Ll*n, H/n, D]: split heads across devices,
+        # gather sequence. all_to_all(split_axis=heads, concat_axis=seq).
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    B, L, Hl, D = qh.shape
+    scale = 1.0 / (D**0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) * scale
+    if causal:
+        pos = jnp.arange(L)
+        s = jnp.where(pos[None, :] <= pos[:, None], s, _NEG)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, vh)
+    del n, H
+    return heads_to_seq(out)

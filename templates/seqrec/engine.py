@@ -1,0 +1,138 @@
+"""Sequential recommendation engine template — self-attentive next-item
+prediction with long-history sequence parallelism.
+
+No counterpart exists in the reference (it predates sequence models; its
+closest relative is the MarkovChain experimental engine, reference
+e2/src/main/scala/io/prediction/e2/engine/MarkovChain.scala:201-260).
+This template is the framework-native sequence family: "view"/"buy"/"rate"
+events become per-user time-ordered item histories; a causal-attention
+model (models/seq_attention.py) predicts the next item; histories longer
+than one chip shard over a ``seq`` mesh axis via ring attention.
+
+Query:  {"user": "u1", "num": 4}
+Result: {"itemScores": [{"item": "i1", "score": 3.2}, ...]}
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    Params,
+    Preparator,
+    SanityCheck,
+)
+from predictionio_tpu.models.seq_attention import (
+    SeqRecConfig,
+    SeqRecModel,
+    build_sequences,
+    train_seq_rec,
+)
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "MyApp"
+    event_names: tuple = ("view", "buy", "rate")
+
+
+@dataclass(frozen=True)
+class AlgorithmParams(Params):
+    max_len: int = 64
+    embed_dim: int = 48
+    num_heads: int = 2
+    num_blocks: int = 2
+    epochs: int = 10
+    batch_size: int = 256
+    lr: float = 1e-3
+    seq_parallel: bool = False
+    seed: int = 0
+
+
+@dataclass(frozen=True)
+class Query:
+    user: str
+    num: int = 10
+
+
+@dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclass(frozen=True)
+class PredictedResult:
+    itemScores: tuple = ()
+
+
+class TrainingData(SanityCheck):
+    def __init__(self, users, items, times):
+        self.users = users
+        self.items = items
+        self.times = times
+
+    def sanity_check(self) -> None:
+        if len(self.users) == 0:
+            raise ValueError("No interaction events found; import data first.")
+
+
+class SeqDataSource(DataSource):
+    params_class = DataSourceParams
+
+    def read_training(self, ctx) -> TrainingData:
+        frame = ctx.event_store().find_frame(
+            app_name=self.params.app_name,
+            entity_type="user",
+            event_names=tuple(self.params.event_names),
+            target_entity_type="item",
+        )
+        has_target = np.asarray(
+            [t is not None for t in frame.target_entity_id], dtype=bool
+        )
+        frame = frame.select(has_target)
+        return TrainingData(frame.entity_id, frame.target_entity_id,
+                            frame.event_time)
+
+
+class SeqPreparator(Preparator):
+    def prepare(self, ctx, td: TrainingData) -> TrainingData:
+        return td
+
+
+class SeqRecAlgorithm(Algorithm):
+    params_class = AlgorithmParams
+    query_class = Query
+
+    def train(self, ctx, td: TrainingData) -> SeqRecModel:
+        p = self.params
+        cfg = SeqRecConfig(
+            max_len=p.max_len, embed_dim=p.embed_dim, num_heads=p.num_heads,
+            num_blocks=p.num_blocks, epochs=p.epochs, batch_size=p.batch_size,
+            lr=p.lr, seq_parallel=p.seq_parallel, seed=p.seed,
+        )
+        seqs, uids, iids = build_sequences(
+            td.users, td.items, td.times, max_len=cfg.max_len
+        )
+        return train_seq_rec(seqs, uids, iids, cfg, mesh=ctx.mesh)
+
+    def predict(self, model: SeqRecModel, query: Query) -> PredictedResult:
+        recs = model.recommend_products(query.user, query.num)
+        return PredictedResult(
+            itemScores=tuple(ItemScore(item=i, score=s) for i, s in recs)
+        )
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_classes=SeqDataSource,
+        preparator_classes=SeqPreparator,
+        algorithm_classes={"seqrec": SeqRecAlgorithm},
+        serving_classes=FirstServing,
+    )

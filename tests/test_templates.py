@@ -1,7 +1,6 @@
-"""Template engines: classification, similarproduct, ecommerce — trained
-against the in-memory event store, predictions verified including the
-serving-time business filters (the reference's judge-checked workloads,
-SURVEY §2.8)."""
+"""Template engines — trained against the in-memory event store,
+predictions verified including the serving-time business filters (the
+reference's judge-checked workloads, SURVEY §2.8)."""
 
 import importlib.util
 import sys
@@ -184,3 +183,32 @@ class TestECommerce:
         # totally unknown user -> empty
         out = algo.predict(model, mod.Query(user="ghost", num=3))
         assert out.itemScores == ()
+
+
+class TestSeqRec:
+    def test_next_item_prediction(self, mesh8):
+        mod = load_template("seqrec")
+        app = setup_app()
+        # cyclic histories shorter than the catalog: user u views 4 of 6
+        # items, so the cycle's next item is always unseen
+        n_items = 6
+        for u in range(48):
+            for t in range(4):
+                insert(app.id, event="view", entity_type="user",
+                       entity_id=f"u{u}", target_entity_type="item",
+                       target_entity_id=f"i{(u + t) % n_items}")
+        engine = mod.engine_factory()
+        ep = EngineParams(
+            data_source_params=("", mod.DataSourceParams(app_name="MyApp")),
+            algorithm_params_list=(
+                ("seqrec", mod.AlgorithmParams(
+                    max_len=4, embed_dim=32, num_heads=2, num_blocks=1,
+                    epochs=40, batch_size=48, lr=3e-3)),
+            ),
+        )
+        result = engine.train(Context(), ep)
+        algo, model = result.algorithms[0], result.models[0]
+        # u0 viewed i0..i3; the learned cycle continues with i4
+        out = algo.predict(model, mod.Query(user="u0", num=2))
+        assert out.itemScores
+        assert out.itemScores[0].item == "i4"
