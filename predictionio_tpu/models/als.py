@@ -38,6 +38,7 @@ from typing import Any
 import numpy as np
 
 from ..ops.neighbors import DegreeBucket, build_degree_buckets
+from ..ops.retrieval import RetrievalServingMixin
 from ..storage.bimap import BiMap
 from ..storage.frame import Ratings
 
@@ -62,7 +63,7 @@ class ALSConfig:
 
 
 @dataclasses.dataclass
-class ALSModel:
+class ALSModel(RetrievalServingMixin):
     """Trained factors + id maps. Arrays are host numpy (device-independent
     for checkpointing); ``scores_for_user`` & co. jit on demand."""
 
@@ -82,13 +83,17 @@ class ALSModel:
     def recommend_products(self, user_id: str, num: int) -> list[tuple[str, float]]:
         """Top-N items for a user (reference ALSModel.recommendProducts,
         examples/.../ALSModel.scala:200-219)."""
-        scores = self.scores_for_user(user_id)
-        if scores is None:
+        row = self.user_ids.get(user_id)
+        if row is None:
             return []
+        inv = self.item_ids.inverse
+        via_device = self._retriever_topk(self.user_factors[row], num, inv)
+        if via_device is not None:
+            return via_device
+        scores = self.item_factors @ self.user_factors[row]
         num = min(num, len(scores))
         top = np.argpartition(-scores, num - 1)[:num]
         top = top[np.argsort(-scores[top])]
-        inv = self.item_ids.inverse
         return [(inv[int(i)], float(scores[i])) for i in top]
 
     def similar_items(self, item_rows: list[int], num: int,

@@ -19,6 +19,7 @@ matmul + top-k on TPU).
 from __future__ import annotations
 
 import dataclasses
+import functools
 from functools import partial
 from typing import Any
 
@@ -146,6 +147,16 @@ def _make_model(n_items: int, cfg: SeqRecConfig, mesh=None):
     return SeqRec()
 
 
+@functools.lru_cache(maxsize=None)
+def _jitted_apply(n_items: int, cfg: SeqRecConfig):
+    """Serving forward, compiled once per (catalog size, config) — a fresh
+    jit per query would retrace + recompile on every request."""
+    import jax
+
+    model = _make_model(n_items, cfg)
+    return jax.jit(model.apply)
+
+
 @dataclasses.dataclass
 class SeqRecModel:
     params: Any
@@ -155,10 +166,9 @@ class SeqRecModel:
     config: SeqRecConfig
 
     def _apply(self, seq_batch):
-        import jax
-
-        model = _make_model(len(self.item_ids), self.config)
-        return np.asarray(jax.jit(model.apply)(self.params, seq_batch))
+        return np.asarray(
+            _jitted_apply(len(self.item_ids), self.config)(self.params, seq_batch)
+        )
 
     def recommend_products(
         self, user_id: str, num: int, *, exclude_seen: bool = True
@@ -231,10 +241,14 @@ def train_seq_rec(
     per = mesh.shape.get("data", 1)
     bs = min(cfg.batch_size, max(per, n))
     bs = max(per, (bs // per) * per)
-    order = np.asarray(jax.random.permutation(kshuf, n))
+    ep_key = kshuf
     for _ep in range(cfg.epochs):
-        for start in range(0, n - bs + 1, bs):
-            batch = seqs[active[order[start : start + bs]]]
+        ep_key, sub = jax.random.split(ep_key)  # reshuffle every epoch
+        order = np.asarray(jax.random.permutation(sub, n))
+        for start in range(0, n, bs):
+            # wrap the tail so no user is silently dropped from training
+            idx = order[np.arange(start, start + bs) % n]
+            batch = seqs[active[idx]]
             if data_sh is not None:
                 batch = jax.device_put(batch, data_sh)
             params, opt_state, _loss = train_step(params, opt_state, batch)

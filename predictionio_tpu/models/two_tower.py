@@ -23,6 +23,7 @@ from typing import Any
 
 import numpy as np
 
+from ..ops.retrieval import RetrievalServingMixin
 from ..storage.bimap import BiMap
 from ..storage.frame import Ratings
 
@@ -63,7 +64,8 @@ def _make_towers(n_users: int, n_items: int, cfg: TwoTowerConfig):
 
 
 @dataclasses.dataclass
-class TwoTowerModel:
+class TwoTowerModel(RetrievalServingMixin):
+    _retrieval_attr = "item_embeddings"
     user_params: Any
     item_params: Any
     user_embeddings: np.ndarray  # [NU, D] precomputed
@@ -76,11 +78,14 @@ class TwoTowerModel:
         row = self.user_ids.get(user_id)
         if row is None:
             return []
+        inv = self.item_ids.inverse
+        via_device = self._retriever_topk(self.user_embeddings[row], num, inv)
+        if via_device is not None:
+            return via_device
         scores = self.item_embeddings @ self.user_embeddings[row]
         num = min(num, len(scores))
         top = np.argpartition(-scores, num - 1)[:num]
         top = top[np.argsort(-scores[top])]
-        inv = self.item_ids.inverse
         return [(inv[int(i)], float(scores[i])) for i in top]
 
 

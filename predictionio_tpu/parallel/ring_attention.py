@@ -115,10 +115,14 @@ def blockwise_attention(q, k, v, *, causal: bool = False, block_size: int = 512)
 
     B, L, H, D = q.shape
     scale = 1.0 / (D**0.5)
-    nblk = max(1, (L + block_size - 1) // block_size)
-    if L % nblk:
-        raise ValueError(f"L={L} not divisible into {nblk} blocks")
-    bs = L // nblk
+    bs = min(block_size, L)
+    nblk = (L + bs - 1) // bs
+    L_pad = nblk * bs
+    if L_pad != L:
+        # pad K/V to whole blocks; padded keys are masked out below
+        pad = [(0, 0), (0, L_pad - L), (0, 0), (0, 0)]
+        k = jnp.pad(k, pad)
+        v = jnp.pad(v, pad)
     q_pos = jnp.arange(L)
     kr = k.reshape(B, nblk, bs, H, D)
     vr = v.reshape(B, nblk, bs, H, D)
@@ -127,11 +131,10 @@ def blockwise_attention(q, k, v, *, causal: bool = False, block_size: int = 512)
         m, acc, l = carry  # noqa: E741
         k_blk = jax.lax.dynamic_index_in_dim(kr, i, 1, keepdims=False)
         v_blk = jax.lax.dynamic_index_in_dim(vr, i, 1, keepdims=False)
+        k_pos = i * bs + jnp.arange(bs)
+        mask = jnp.broadcast_to((k_pos < L)[None, :], (L, bs))
         if causal:
-            k_pos = i * bs + jnp.arange(bs)
-            mask = k_pos[None, :] <= q_pos[:, None]
-        else:
-            mask = None
+            mask = mask & (k_pos[None, :] <= q_pos[:, None])
         bm, bpv, bl = _block_attn(q, k_blk, v_blk, scale, mask)
         m_new = jnp.maximum(m, bm)
         alpha = jnp.exp(jnp.where(m > _NEG / 2, m - m_new, 0.0))
@@ -189,6 +192,11 @@ def ulysses_attention(q, k, v, axis_name: str = "seq", *, causal: bool = False):
 
     n = jax.lax.psum(1, axis_name)
     H = q.shape[2]
+    if H % n:
+        raise ValueError(
+            f"ulysses_attention needs heads ({H}) divisible by the "
+            f"'{axis_name}' axis size ({n})"
+        )
 
     def seq_to_heads(x):
         # [B, Ll, H, D] -> [B, Ll*n, H/n, D]: split heads across devices,
@@ -209,5 +217,4 @@ def ulysses_attention(q, k, v, axis_name: str = "seq", *, causal: bool = False):
         s = jnp.where(pos[None, :] <= pos[:, None], s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", p, vh)
-    del n, H
     return heads_to_seq(out)

@@ -68,6 +68,24 @@ class Deployed:
     instance: EngineInstance
     result: TrainResult
 
+    def __post_init__(self):
+        # On TPU backends, move catalog factors device-resident so queries
+        # run through the fused Pallas top-k kernel. Building the retriever
+        # on the NEW bundle before the swap is the double-buffered /reload:
+        # the old bundle keeps serving until this one is fully on-device.
+        import jax
+
+        if jax.default_backend() != "tpu":
+            return
+        for model in self.result.models:
+            attach = getattr(model, "attach_retriever", None)
+            if attach is not None:
+                try:
+                    attach()
+                except Exception:  # pragma: no cover - serving must not die
+                    log.exception("device retriever attach failed; "
+                                  "serving falls back to host scoring")
+
 
 class EngineServer:
     """Holds the deployed bundle + bookkeeping; handlers delegate here."""

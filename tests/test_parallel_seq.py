@@ -164,3 +164,15 @@ def test_seq_rec_seq_parallel_matches_serial(seq_mesh):
     a = serial.apply(params, jnp.asarray(seqs))
     b = ring.apply(params, jnp.asarray(seqs))
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("L,bs", [(25, 10), (24, 10), (7, 512)])
+def test_blockwise_attention_unaligned_blocks(rng, L, bs):
+    """block_size need not divide L: the tail K/V block is padded and the
+    padded keys masked out."""
+    q, k, v = qkv(rng, L=L)
+    for causal in (False, True):
+        want = dense_attention(q, k, v, causal=causal)
+        got = blockwise_attention(q, k, v, causal=causal, block_size=bs)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)

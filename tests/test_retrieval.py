@@ -1,0 +1,91 @@
+"""Fused top-k retrieval kernel (ops/retrieval.py), interpret mode on the
+CPU backend — values and indices must match exact numpy scoring."""
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.ops.retrieval import DeviceRetriever, topk_scores
+
+
+def exact_topk(q, items, k):
+    scores = q @ items.T  # [B, N]
+    idx = np.argsort(-scores, axis=1, kind="stable")[:, :k]
+    vals = np.take_along_axis(scores, idx, axis=1)
+    return vals, idx
+
+
+@pytest.mark.parametrize("B,N,D,k", [
+    (1, 100, 10, 5),       # tiny, unpadded everything
+    (3, 1000, 32, 10),     # N not a multiple of the tile
+    (8, 512, 64, 512),     # k == N (full ranking)
+    (2, 2000, 16, 1),      # k = 1
+])
+def test_matches_exact(rng, B, N, D, k):
+    q = rng.standard_normal((B, D)).astype(np.float32)
+    items = rng.standard_normal((N, D)).astype(np.float32)
+    vals, idx = topk_scores(q, items, k, tile_n=512)
+    want_v, want_i = exact_topk(q, items, k)
+    np.testing.assert_allclose(vals, want_v, rtol=1e-5, atol=1e-5)
+    # indices may differ on exact ties; compare score-at-index instead
+    got_scores = np.take_along_axis(q @ items.T, idx.astype(np.int64), axis=1)
+    np.testing.assert_allclose(got_scores, want_v, rtol=1e-5, atol=1e-5)
+    assert (idx >= 0).all() and (idx < N).all()
+
+
+def test_single_query_vector(rng):
+    q = rng.standard_normal(24).astype(np.float32)
+    items = rng.standard_normal((300, 24)).astype(np.float32)
+    vals, idx = topk_scores(q, items, 7)
+    assert vals.shape == (7,) and idx.shape == (7,)
+    want = np.sort(items @ q)[::-1][:7]
+    np.testing.assert_allclose(vals, want, rtol=1e-5, atol=1e-5)
+
+
+def test_k_larger_than_catalog(rng):
+    q = rng.standard_normal((2, 8)).astype(np.float32)
+    items = rng.standard_normal((5, 8)).astype(np.float32)
+    vals, idx = topk_scores(q, items, 20)
+    assert vals.shape == (2, 5)
+    want_v, _ = exact_topk(q, items, 5)
+    np.testing.assert_allclose(vals, want_v, rtol=1e-5, atol=1e-5)
+
+
+def test_empty_catalog():
+    vals, idx = topk_scores(np.zeros((2, 4), np.float32),
+                            np.zeros((0, 4), np.float32), 3)
+    assert vals.shape == (2, 0) and idx.shape == (2, 0)
+
+
+def test_device_retriever_reuse(rng):
+    items = rng.standard_normal((777, 48)).astype(np.float32)
+    r = DeviceRetriever(items)
+    for _ in range(2):  # second call hits the jit cache
+        q = rng.standard_normal((4, 48)).astype(np.float32)
+        vals, idx = r.topk(q, 9)
+        want_v, _ = exact_topk(q, items, 9)
+        np.testing.assert_allclose(vals, want_v, rtol=1e-5, atol=1e-5)
+
+
+def test_als_model_retriever_matches_host(rng):
+    from predictionio_tpu.models.als import ALSConfig, ALSModel
+    from predictionio_tpu.storage.bimap import BiMap
+    import pickle
+
+    nu, ni, r = 6, 40, 8
+    uids = BiMap({f"u{i}": i for i in range(nu)})
+    iids = BiMap({f"i{i}": i for i in range(ni)})
+    m = ALSModel(
+        user_factors=rng.standard_normal((nu, r)).astype(np.float32),
+        item_factors=rng.standard_normal((ni, r)).astype(np.float32),
+        user_ids=uids, item_ids=iids, config=ALSConfig(rank=r),
+    )
+    host = m.recommend_products("u3", 5)
+    m.attach_retriever(interpret=True)
+    dev = m.recommend_products("u3", 5)
+    assert [i for i, _ in dev] == [i for i, _ in host]
+    np.testing.assert_allclose([s for _, s in dev], [s for _, s in host],
+                               rtol=1e-5, atol=1e-5)
+    # device arrays never enter the pickled MODELDATA blob
+    m2 = pickle.loads(pickle.dumps(m))
+    assert getattr(m2, "_retriever", None) is None
+    assert m2.recommend_products("u3", 5)
