@@ -147,7 +147,7 @@ def _make_model(n_items: int, cfg: SeqRecConfig, mesh=None):
     return SeqRec()
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=8)
 def _jitted_apply(n_items: int, cfg: SeqRecConfig):
     """Serving forward, compiled once per (catalog size, config) — a fresh
     jit per query would retrace + recompile on every request."""

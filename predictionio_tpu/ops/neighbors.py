@@ -22,10 +22,14 @@ import math
 
 import numpy as np
 
+from .. import native
+
 __all__ = [
     "DegreeBucket", "NeighborBlocks", "build_degree_buckets",
     "build_neighbor_blocks",
 ]
+
+_splitmix64 = native.splitmix64_np
 
 
 @dataclasses.dataclass
@@ -122,9 +126,14 @@ def build_neighbor_blocks(
 
     - D = min(max observed degree, ``degree_cap``) rounded up to a multiple
       of 8 (float32 sublane tiling).
-    - Rows with degree > D keep a deterministic random subsample (the
-    	same trade MLlib users make with sampling heavy users).
+    - Rows with degree > D keep a deterministic hash-keyed subsample (the
+      same trade MLlib users make with sampling heavy users); the key is
+      splitmix64(seed, row, pos) so the native C++ path and the numpy
+      fallback produce identical layouts.
     - Rows padded to a multiple of ``block_rows``.
+
+    Dispatches to the C++ counting-sort kernel (predictionio_tpu/native)
+    when built; falls back to numpy sorts otherwise.
     """
     if len(rows) == 0:
         d = 8
@@ -139,16 +148,34 @@ def build_neighbor_blocks(
             dropped=0,
         )
 
-    order = np.argsort(rows, kind="stable")
-    r_sorted = rows[order]
-    c_sorted = cols[order].astype(np.int32)
-    v_sorted = vals[order].astype(np.float32)
-
-    counts = np.bincount(r_sorted, minlength=num_rows)
+    rows = np.asarray(rows, np.int64)
+    counts = np.bincount(rows, minlength=num_rows)
     observed_max = int(counts.max())
     d = observed_max if max_degree is None else min(max_degree, observed_max)
     d = min(d, degree_cap)
     d = max(8, ((d + 7) // 8) * 8)
+
+    nb = max(1, math.ceil(num_rows / block_rows))
+    padded_rows = nb * block_rows
+
+    nat = native.neighbor_blocks_native(
+        rows, cols, vals, num_rows, padded_rows, d, seed
+    ) if native.available() else None
+    if nat is not None:
+        ids, vv, mask, dropped = nat
+        return NeighborBlocks(
+            ids=ids.reshape(nb, block_rows, d),
+            vals=vv.reshape(nb, block_rows, d),
+            mask=mask.reshape(nb, block_rows, d),
+            num_rows=num_rows,
+            max_degree=d,
+            dropped=dropped,
+        )
+
+    order = np.argsort(rows, kind="stable")
+    r_sorted = rows[order]
+    c_sorted = cols[order].astype(np.int32)
+    v_sorted = vals[order].astype(np.float32)
 
     # position of each entry within its row
     starts = np.zeros(num_rows + 1, dtype=np.int64)
@@ -158,11 +185,13 @@ def build_neighbor_blocks(
     dropped = 0
     overflow = counts > d
     if overflow.any():
-        # deterministic per-row subsample: random permutation rank, keep < d
-        rng = np.random.default_rng(seed)
-        rand_key = rng.random(len(r_sorted))
-        # rank entries within each row by random key
-        order2 = np.lexsort((rand_key, r_sorted))
+        # deterministic per-row subsample: keep the d smallest
+        # splitmix64(seed, row, pos) keys — same scheme as the C++ kernel
+        key = _splitmix64(
+            _splitmix64(np.uint64(seed) + r_sorted.astype(np.uint64))
+            + pos_in_row.astype(np.uint64)
+        )
+        order2 = np.lexsort((key, r_sorted))
         rank = np.empty(len(r_sorted), dtype=np.int64)
         rank[order2] = np.arange(len(r_sorted)) - starts[r_sorted[order2]]
         keep = rank < d
@@ -173,8 +202,6 @@ def build_neighbor_blocks(
         np.cumsum(counts, out=starts[1:])
         pos_in_row = np.arange(len(r_sorted)) - starts[r_sorted]
 
-    nb = max(1, math.ceil(num_rows / block_rows))
-    padded_rows = nb * block_rows
     ids = np.zeros((padded_rows, d), np.int32)
     vv = np.zeros((padded_rows, d), np.float32)
     mask = np.zeros((padded_rows, d), np.float32)

@@ -101,7 +101,9 @@ def _topk_kernel(q_ref, items_ref, vals_ref, idx_ref, *, k, tile_n, n_total):
 
 
 @functools.partial(
-    functools.lru_cache(maxsize=None),
+    # bounded: a long-lived server reloading a growing catalog must not
+    # accumulate one compiled kernel per historical catalog size
+    functools.lru_cache(maxsize=16),
 )
 def _build_call(B, D, N_pad, n_total, k, tile_n, interpret):
     import jax

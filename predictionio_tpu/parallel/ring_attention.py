@@ -132,9 +132,12 @@ def blockwise_attention(q, k, v, *, causal: bool = False, block_size: int = 512)
         k_blk = jax.lax.dynamic_index_in_dim(kr, i, 1, keepdims=False)
         v_blk = jax.lax.dynamic_index_in_dim(vr, i, 1, keepdims=False)
         k_pos = i * bs + jnp.arange(bs)
-        mask = jnp.broadcast_to((k_pos < L)[None, :], (L, bs))
+        mask = None
+        if L_pad != L:
+            mask = jnp.broadcast_to((k_pos < L)[None, :], (L, bs))
         if causal:
-            mask = mask & (k_pos[None, :] <= q_pos[:, None])
+            cm = k_pos[None, :] <= q_pos[:, None]
+            mask = cm if mask is None else mask & cm
         bm, bpv, bl = _block_attn(q, k_blk, v_blk, scale, mask)
         m_new = jnp.maximum(m, bm)
         alpha = jnp.exp(jnp.where(m > _NEG / 2, m - m_new, 0.0))

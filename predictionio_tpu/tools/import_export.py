@@ -11,12 +11,89 @@ from __future__ import annotations
 
 import json
 from pathlib import Path
+from typing import Iterator
 
+from .. import native
 from ..storage import EventQuery, Storage, event_from_api_dict, event_to_api_dict
 
 __all__ = ["import_events", "export_events"]
 
 _BATCH = 2000
+
+# scan_jsonl field slots that hold JSON strings (fragment keeps its quotes)
+_STR_FIELDS = tuple(
+    i for i, name in enumerate(native.JSONL_FIELDS)
+    if name not in ("properties", "tags")
+)
+_PROPS = native.JSONL_FIELDS.index("properties")
+_TAGS = native.JSONL_FIELDS.index("tags")
+
+
+_CHUNK = 8 << 20  # newline-aligned chunk size for the streaming scan
+
+
+def _parse_jsonl_native(data: bytes) -> list[dict] | None:
+    """Decode events via the C++ line scanner: only the tiny per-field
+    fragments go through ``json.loads`` instead of every full line. Returns
+    None when the native library is absent or a line needs the full parser;
+    raises ValueError/JSONDecodeError when a fragment itself is bad JSON."""
+    scanned = native.scan_jsonl(data)
+    if scanned is None:
+        return None
+    n, starts, ends = scanned
+    out: list[dict] = []
+    for i in range(n):
+        d: dict = {}
+        s_row, e_row = starts[i], ends[i]
+        for f in _STR_FIELDS:
+            s, e = s_row[f], e_row[f]
+            if s == e:
+                continue
+            frag = data[s:e]
+            if frag[:1] == b'"' and b"\\" not in frag:
+                d[native.JSONL_FIELDS[f]] = frag[1:-1].decode()
+            else:
+                d[native.JSONL_FIELDS[f]] = json.loads(frag)
+        for f in (_PROPS, _TAGS):
+            s, e = s_row[f], e_row[f]
+            if s != e:
+                d[native.JSONL_FIELDS[f]] = json.loads(data[s:e])
+        out.append(d)
+    return out
+
+
+def _iter_chunks(f) -> "Iterator[bytes]":
+    """Yield newline-aligned chunks so the native scanner never sees a
+    split line."""
+    while True:
+        chunk = f.read(_CHUNK)
+        if not chunk:
+            return
+        if chunk[-1:] != b"\n":
+            chunk += f.readline()
+        yield chunk
+
+
+def _iter_event_dicts(f, path) -> "Iterator[tuple[int, dict]]":
+    """Stream (file_line_no, event_dict) pairs; native scan per chunk with
+    per-chunk fallback to the full JSON parser."""
+    line_no = 0
+    for chunk in _iter_chunks(f):
+        lines = chunk.split(b"\n")
+        nonblank = [(line_no + i + 1, ln) for i, ln in enumerate(lines) if ln.strip()]
+        line_no += len(lines) - 1  # last split element is the b"" after trailing \n
+        try:
+            dicts = _parse_jsonl_native(chunk)
+        except ValueError:
+            dicts = None
+        if dicts is not None and len(dicts) == len(nonblank):
+            yield from zip((no for no, _ in nonblank), dicts)
+            continue
+        for no, ln in nonblank:
+            try:
+                yield no, json.loads(ln)
+            except json.JSONDecodeError as e:
+                raise ValueError(f"{path}:{no}: {e}") from e
 
 
 def import_events(path: str | Path, app_id: int, channel_id: int | None = None) -> int:
@@ -24,14 +101,11 @@ def import_events(path: str | Path, app_id: int, channel_id: int | None = None) 
     events.init_app(app_id, channel_id)
     n = 0
     batch = []
-    with open(path) as f:
-        for line_no, line in enumerate(f, 1):
-            line = line.strip()
-            if not line:
-                continue
+    with open(path, "rb") as f:
+        for line_no, d in _iter_event_dicts(f, path):
             try:
-                batch.append(event_from_api_dict(json.loads(line)))
-            except (json.JSONDecodeError, ValueError) as e:
+                batch.append(event_from_api_dict(d))
+            except ValueError as e:
                 raise ValueError(f"{path}:{line_no}: {e}") from e
             if len(batch) >= _BATCH:
                 events.insert_batch(batch, app_id, channel_id)
