@@ -118,6 +118,31 @@ class ALSModel(RetrievalServingMixin):
         return [(int(i), float(scores[i])) for i in top if np.isfinite(scores[i])]
 
 
+def _run_fingerprint(ratings: Ratings, config: ALSConfig) -> int:
+    """64-bit fingerprint of (ratings, config) gating checkpoint resume.
+    crc32 runs at memory speed, so hashing 20M triples is negligible next
+    to one training iteration."""
+    import json
+    import zlib
+
+    cfg_d = dataclasses.asdict(config)
+    # iterations excluded: continuing a crashed or shorter run to a larger
+    # iteration target is legitimate resume (the `it <= iterations` check
+    # handles checkpoints past the current target)
+    cfg_d.pop("iterations", None)
+    cfg_js = json.dumps(cfg_d, sort_keys=True, default=str)
+    parts = (
+        zlib.crc32(np.ascontiguousarray(ratings.user_indices).tobytes()),
+        zlib.crc32(np.ascontiguousarray(ratings.item_indices).tobytes()),
+        zlib.crc32(np.ascontiguousarray(ratings.ratings).tobytes()),
+        zlib.crc32(cfg_js.encode()),
+    )
+    h = 0xCBF29CE484222325
+    for p in parts:
+        h = ((h ^ p) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
 # ---------------------------------------------------------------------------
 # the pjit'd half-step
 # ---------------------------------------------------------------------------
@@ -214,8 +239,15 @@ def make_train_step(mesh, *, rank, lambda_=0.1, implicit=False, alpha=1.0,
     return jax.jit(step, out_shardings=(fac, fac), donate_argnums=(2,))
 
 
-def train_als(ratings: Ratings, config: ALSConfig, mesh=None) -> ALSModel:
-    """Alternate user/item half-steps for ``config.iterations`` rounds."""
+def train_als(ratings: Ratings, config: ALSConfig, mesh=None, *,
+              checkpointer=None, checkpoint_every: int = 0) -> ALSModel:
+    """Alternate user/item half-steps for ``config.iterations`` rounds.
+
+    With a ``TrainCheckpointer`` and ``checkpoint_every > 0``, the
+    item-factor matrix + iteration counter snapshot every k iterations and
+    a rerun with the same checkpoint directory resumes from the latest
+    step — mid-training resume the reference lacks (its only persistence
+    is the finished model, CoreWorkflow.scala:69-74)."""
     import jax
     import jax.numpy as jnp
 
@@ -247,21 +279,61 @@ def train_als(ratings: Ratings, config: ALSConfig, mesh=None) -> ALSModel:
     u_bk = _put_buckets(user_buckets, mesh)
     i_bk = _put_buckets(item_buckets, mesh)
 
-    key = jax.random.PRNGKey(config.seed)
-    _k_u, k_v = jax.random.split(key)
-    # MLlib-style init: small positive factors
-    v = jax.device_put(
-        jnp.abs(jax.random.normal(k_v, (ni, rank), dtype=jnp.float32)) / jnp.sqrt(rank),
-        rep,
-    )
+    # run fingerprint: a checkpoint is only resumable for the exact same
+    # ratings + config — resuming across changed data or hyperparameters
+    # would silently return a model of the wrong run
+    fp = _run_fingerprint(ratings, config)
+
+    start_it = 0
+    v = None
+    u_restored = None
+    if checkpointer is not None:
+        restored = checkpointer.restore()
+        if restored is not None:
+            ck_step, state = restored
+            v_arr, u_arr = state.get("v"), state.get("u")
+            if (state.get("fp") is not None and int(state["fp"]) == fp
+                    and v_arr is not None and u_arr is not None
+                    and v_arr.shape == (ni, rank) and u_arr.shape == (nu, rank)
+                    and int(state["it"]) <= config.iterations):
+                start_it = int(state["it"])
+                v = jax.device_put(jnp.asarray(v_arr), rep)
+                u_restored = jax.device_put(jnp.asarray(u_arr), rep)
+                log.info("resuming ALS from checkpoint step %d (iter %d)",
+                         ck_step, start_it)
+            else:
+                log.warning("checkpoint at step %s is from a different "
+                            "run (data/config fingerprint mismatch); "
+                            "starting fresh", ck_step)
+    if v is None:
+        key = jax.random.PRNGKey(config.seed)
+        _k_u, k_v = jax.random.split(key)
+        # MLlib-style init: small positive factors
+        v = jax.device_put(
+            jnp.abs(jax.random.normal(k_v, (ni, rank), dtype=jnp.float32)) / jnp.sqrt(rank),
+            rep,
+        )
 
     step = make_train_step(
         mesh, rank=rank, lambda_=config.lambda_,
         implicit=config.implicit_prefs, alpha=config.alpha, nu=nu, ni=ni,
     )
     u = None
-    for _it in range(config.iterations):
+    for it in range(start_it, config.iterations):
         u, v = step(u_bk, i_bk, v)
+        done = it + 1
+        if (checkpointer is not None and checkpoint_every > 0
+                and (done % checkpoint_every == 0 or done == config.iterations)):
+            # both sides: the final model pairs u_k (solved from v_{k-1})
+            # with v_k, so v alone cannot reconstruct it exactly
+            checkpointer.save(done, {"u": u, "v": v, "it": np.int64(done),
+                                     "fp": np.uint64(fp)})
+    if u is None:
+        # checkpoint was already at the final iteration
+        u = u_restored if u_restored is not None else _solve_side(
+            u_bk, v, nu, kw=dict(
+                lambda_=config.lambda_, implicit=config.implicit_prefs,
+                alpha=config.alpha, rank=rank))
     u.block_until_ready()
     log.info("ALS done: %d iters, U %s, V %s", config.iterations, (nu, rank), (ni, rank))
 

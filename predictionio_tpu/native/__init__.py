@@ -58,15 +58,21 @@ _tried = False
 
 
 def _build() -> bool:
+    # compile to a per-process temp path and rename into place: concurrent
+    # importers (multi-host loaders, pytest-xdist) must never observe a
+    # half-written .so, and os.replace is atomic on POSIX
+    tmp = _LIB_PATH.with_suffix(f".so.tmp.{os.getpid()}")
     cmd = [
         os.environ.get("CXX", "g++"), "-O3", "-std=c++17", "-fPIC", "-shared",
-        str(_SRC), "-o", str(_LIB_PATH),
+        str(_SRC), "-o", str(tmp),
     ]
     try:
         _BUILD_DIR.mkdir(exist_ok=True)
         subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        os.replace(tmp, _LIB_PATH)
     except (OSError, subprocess.SubprocessError) as e:
         logger.warning("pio_native build failed, using numpy fallbacks: %s", e)
+        tmp.unlink(missing_ok=True)
         return False
     return True
 
@@ -95,9 +101,16 @@ def _load() -> ctypes.CDLL | None:
             return None
         try:
             lib = ctypes.CDLL(str(_LIB_PATH))
-        except OSError as e:
-            logger.warning("pio_native load failed: %s", e)
-            return None
+        except OSError:
+            # the cached lib may be corrupt (e.g. a pre-atomic-rename
+            # partial write); one rebuild attempt before giving up
+            if not _build():
+                return None
+            try:
+                lib = ctypes.CDLL(str(_LIB_PATH))
+            except OSError as e:
+                logger.warning("pio_native load failed: %s", e)
+                return None
 
         i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
         i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")

@@ -324,6 +324,10 @@ int64_t pio_scan_jsonl(const char* buf, int64_t len, int64_t max_lines,
         const char* ks;
         const char* ke;
         if (!sc.scan_string(&ks, &ke)) return -(line + 1);
+        // an escaped key (e.g. "event") would defeat the raw-byte
+        // field match below — punt the whole line to the full parser
+        if (memchr(ks, '\\', static_cast<size_t>(ke - ks)) != nullptr)
+          return -(line + 1);
         sc.skip_ws();
         if (sc.eof() || *sc.p != ':') return -(line + 1);
         ++sc.p;

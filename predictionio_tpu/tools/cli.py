@@ -250,6 +250,8 @@ def cmd_train(args) -> int:
         ),
         mesh_shape=_parse_mesh(args.mesh) if args.mesh else None,
         mesh_axes=("data", "model") if args.mesh else None,
+        checkpoint_dir=args.checkpoint_dir,
+        checkpoint_every=args.checkpoint_every,
     )
     iid = run_train(
         engine,
@@ -474,6 +476,13 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--stop-after-read", action="store_true")
     sp.add_argument("--stop-after-prepare", action="store_true")
     sp.add_argument("--mesh", help="mesh shape, e.g. 4x2 (data x model)")
+    sp.add_argument("--checkpoint-dir", default=None,
+                    help="mid-training checkpoint directory; rerunning "
+                         "train with the same dir resumes from the latest "
+                         "saved step")
+    sp.add_argument("--checkpoint-every", type=int, default=5,
+                    help="checkpoint every N training iterations "
+                         "(with --checkpoint-dir)")
 
     sp = sub.add_parser("eval")
     _add_engine_args(sp)

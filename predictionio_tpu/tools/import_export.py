@@ -41,6 +41,10 @@ def _parse_jsonl_native(data: bytes) -> list[dict] | None:
     if scanned is None:
         return None
     n, starts, ends = scanned
+    # plain Python ints: per-element numpy scalar access in this hot loop
+    # would eat most of the native scanner's win
+    starts = starts.tolist()
+    ends = ends.tolist()
     out: list[dict] = []
     for i in range(n):
         d: dict = {}

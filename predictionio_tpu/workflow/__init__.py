@@ -1,5 +1,6 @@
 """Workflow runtime (L5): train/eval orchestration, context, persistence."""
 
+from .checkpoint import TrainCheckpointer
 from .context import Context, WorkflowParams
 from .core_workflow import (
     engine_params_from_instance,
@@ -17,7 +18,8 @@ from .serialization import (
 )
 
 __all__ = [
-    "Context", "PersistentModelManifest", "RetrainMarker", "WorkflowParams",
+    "Context", "PersistentModelManifest", "RetrainMarker", "TrainCheckpointer",
+    "WorkflowParams",
     "deserialize_models", "engine_params_from_instance", "prepare_deploy",
     "resolve_attr", "resolve_engine_factory", "run_evaluation", "run_train",
     "serialize_models",

@@ -51,6 +51,8 @@ class Context:
         app_name: str | None = None,
         channel_name: str | None = None,
         extra: Mapping[str, Any] | None = None,
+        checkpoint_dir: str | None = None,
+        checkpoint_every: int = 0,
     ):
         self.mode = mode
         self.batch = batch
@@ -59,9 +61,24 @@ class Context:
         self.app_name = app_name
         self.channel_name = channel_name
         self.extra = dict(extra or {})
+        #: mid-training checkpoint/resume knobs (workflow/checkpoint.py);
+        #: algorithms that support step-level resume read these
+        self.checkpoint_dir = checkpoint_dir
+        self.checkpoint_every = checkpoint_every
         self._mesh = None
         self._mesh_shape = mesh_shape
         self._mesh_axes = mesh_axes
+
+    def checkpointer(self, subdir: str = ""):
+        """TrainCheckpointer for this run, or None when checkpointing is
+        off (no --checkpoint-dir)."""
+        if not self.checkpoint_dir:
+            return None
+        from .checkpoint import TrainCheckpointer
+        from pathlib import Path
+
+        d = Path(self.checkpoint_dir)
+        return TrainCheckpointer(d / subdir if subdir else d)
 
     # -- devices -----------------------------------------------------------
     @property

@@ -150,7 +150,11 @@ class ALSAlgorithm(Algorithm):
             lambda_=self.params.lambda_,
             seed=self.params.seed,
         )
-        return train_als(ratings, cfg, mesh=ctx.mesh)
+        return train_als(
+            ratings, cfg, mesh=ctx.mesh,
+            checkpointer=ctx.checkpointer("als"),
+            checkpoint_every=ctx.checkpoint_every,
+        )
 
     def predict(self, model: ALSModel, query: Query) -> PredictedResult:
         recs = model.recommend_products(query.user, query.num)

@@ -1,0 +1,140 @@
+"""Mid-training checkpoint/resume (workflow/checkpoint.py + ALS wiring).
+
+The reference restarts interrupted trainings from scratch (its only
+persistence is the finished model, CoreWorkflow.scala:69-74); the TPU
+build adds step-level resume per SURVEY.md §5. These tests cover the
+checkpointer itself (atomicity, retention, backends) and that a resumed
+ALS run reproduces the uninterrupted run.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.models.als import ALSConfig, train_als
+from predictionio_tpu.storage.bimap import BiMap
+from predictionio_tpu.storage.frame import Ratings
+from predictionio_tpu.workflow.checkpoint import TrainCheckpointer
+
+
+@pytest.fixture(params=["auto", "npz"])
+def ckptr_factory(request, tmp_path):
+    def make(subdir="ck"):
+        return TrainCheckpointer(tmp_path / subdir, backend=request.param)
+    return make
+
+
+class TestTrainCheckpointer:
+    def test_roundtrip(self, ckptr_factory):
+        ck = ckptr_factory()
+        state = {"v": np.arange(12, dtype=np.float32).reshape(3, 4),
+                 "it": np.int64(3)}
+        ck.save(3, state)
+        got_step, got = ck.restore()
+        assert got_step == 3
+        np.testing.assert_array_equal(got["v"], state["v"])
+        assert int(got["it"]) == 3
+
+    def test_latest_and_retention(self, ckptr_factory):
+        ck = ckptr_factory()
+        for s in (1, 2, 3, 4):
+            ck.save(s, {"v": np.full((2, 2), float(s)), "it": np.int64(s)})
+        assert ck.latest_step() == 4
+        assert ck.steps() == [3, 4]  # keep=2 default
+        step, st = ck.restore()
+        assert step == 4 and float(st["v"][0, 0]) == 4.0
+
+    def test_incomplete_step_ignored(self, ckptr_factory, tmp_path):
+        ck = ckptr_factory()
+        ck.save(1, {"v": np.zeros((2, 2)), "it": np.int64(1)})
+        # simulate a crash mid-save: step dir exists, no _COMPLETE marker
+        (ck.directory / "step_2").mkdir()
+        assert ck.latest_step() == 1
+
+    def test_empty(self, ckptr_factory):
+        assert ckptr_factory().restore() is None
+
+
+def _ratings(nu=40, ni=30, n=600, seed=0):
+    rng = np.random.default_rng(seed)
+    return Ratings(
+        user_indices=rng.integers(0, nu, n).astype(np.int64),
+        item_indices=rng.integers(0, ni, n).astype(np.int64),
+        ratings=(rng.random(n).astype(np.float32) * 4 + 1),
+        user_ids=BiMap({f"u{i}": i for i in range(nu)}),
+        item_ids=BiMap({f"i{i}": i for i in range(ni)}),
+    )
+
+
+class TestALSResume:
+    def test_resume_matches_uninterrupted(self, tmp_path):
+        r = _ratings()
+        cfg10 = ALSConfig(rank=8, iterations=10, lambda_=0.1, seed=5)
+        baseline = train_als(r, cfg10)
+
+        ck = TrainCheckpointer(tmp_path / "als")
+        # "crash" after 4 of 10 iterations
+        cfg4 = ALSConfig(rank=8, iterations=4, lambda_=0.1, seed=5)
+        train_als(r, cfg4, checkpointer=ck, checkpoint_every=2)
+        assert ck.latest_step() == 4
+
+        resumed = train_als(r, cfg10, checkpointer=ck, checkpoint_every=2)
+        np.testing.assert_allclose(
+            resumed.item_factors, baseline.item_factors, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(
+            resumed.user_factors, baseline.user_factors, rtol=1e-5, atol=1e-5)
+
+    def test_resume_at_final_iteration(self, tmp_path):
+        r = _ratings()
+        ck = TrainCheckpointer(tmp_path / "als")
+        cfg = ALSConfig(rank=8, iterations=3, lambda_=0.1, seed=5)
+        m1 = train_als(r, cfg, checkpointer=ck, checkpoint_every=1)
+        # rerun with identical iteration count: loop body never executes,
+        # u must still be solved from the restored v
+        m2 = train_als(r, cfg, checkpointer=ck, checkpoint_every=1)
+        np.testing.assert_allclose(m2.user_factors, m1.user_factors,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_shape_mismatch_starts_fresh(self, tmp_path):
+        r = _ratings()
+        ck = TrainCheckpointer(tmp_path / "als")
+        ck.save(2, {"u": np.zeros((5, 3), np.float32),
+                    "v": np.zeros((7, 3), np.float32), "it": np.int64(2)})
+        cfg = ALSConfig(rank=8, iterations=2, lambda_=0.1, seed=5)
+        m = train_als(r, cfg, checkpointer=ck, checkpoint_every=1)
+        assert m.item_factors.shape == (30, 8)
+
+    def test_config_change_invalidates_checkpoint(self, tmp_path):
+        r = _ratings()
+        ck = TrainCheckpointer(tmp_path / "als")
+        cfg_a = ALSConfig(rank=8, iterations=3, lambda_=0.1, seed=5)
+        train_als(r, cfg_a, checkpointer=ck, checkpoint_every=1)
+        # different lambda: the old run's factors must not be resumed
+        cfg_b = ALSConfig(rank=8, iterations=3, lambda_=0.5, seed=5)
+        m_b = train_als(r, cfg_b, checkpointer=ck, checkpoint_every=1)
+        m_b_fresh = train_als(r, cfg_b)
+        np.testing.assert_allclose(m_b.item_factors, m_b_fresh.item_factors,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_data_change_invalidates_checkpoint(self, tmp_path):
+        ck = TrainCheckpointer(tmp_path / "als")
+        cfg = ALSConfig(rank=8, iterations=3, lambda_=0.1, seed=5)
+        train_als(_ratings(seed=0), cfg, checkpointer=ck, checkpoint_every=1)
+        r2 = _ratings(seed=9)  # new events arrived
+        m = train_als(r2, cfg, checkpointer=ck, checkpoint_every=1)
+        m_fresh = train_als(r2, cfg)
+        np.testing.assert_allclose(m.item_factors, m_fresh.item_factors,
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_extend_iterations_resumes(self, tmp_path):
+        r = _ratings()
+        ck = TrainCheckpointer(tmp_path / "als")
+        cfg3 = ALSConfig(rank=8, iterations=3, lambda_=0.1, seed=5)
+        train_als(r, cfg3, checkpointer=ck, checkpoint_every=1)
+        # raising the iteration target continues from step 3
+        cfg6 = ALSConfig(rank=8, iterations=6, lambda_=0.1, seed=5)
+        m = train_als(r, cfg6, checkpointer=ck, checkpoint_every=1)
+        m_fresh = train_als(r, cfg6)
+        np.testing.assert_allclose(m.item_factors, m_fresh.item_factors,
+                                   rtol=1e-5, atol=1e-5)

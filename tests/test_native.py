@@ -154,6 +154,12 @@ class TestJsonlScanner:
         assert native.scan_jsonl(b"[1, 2]") is None
         assert native.scan_jsonl(b'{"event":"a"} trailing') is None
 
+    def test_escaped_key_falls_back(self):
+        # "event" decodes to key "event"; raw-byte matching cannot see
+        # that, so the whole line must fall back to the full parser
+        assert native.scan_jsonl(
+            b'{"\\u0065vent":"rate","entityType":"t","entityId":"i"}') is None
+
     def test_raw_control_chars_rejected(self):
         # strict JSON rejects unescaped control bytes inside strings; the
         # native path must fall back rather than accept what json.loads won't
