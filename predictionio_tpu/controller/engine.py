@@ -138,9 +138,15 @@ class Engine(Generic[TD, EI, PD, Q, P, A]):
 
         names, algos = self.make_algorithms(engine_params)
         models = []
-        for name, algo in zip(names, algos):
+        for i, (name, algo) in enumerate(zip(names, algos)):
             log.info("Training algorithm %r (%s)", name, type(algo).__name__)
-            m = algo.train(ctx, pd)
+            # namespace per-algorithm state (e.g. training checkpoints):
+            # two entries of the same algorithm class must not collide
+            ctx.current_algorithm = f"{name or type(algo).__name__}#{i}"
+            try:
+                m = algo.train(ctx, pd)
+            finally:
+                ctx.current_algorithm = None
             _maybe_sanity_check(m, skip_sanity, f"Model of {type(algo).__name__}")
             models.append(m)
         serving = self.make_serving(engine_params)

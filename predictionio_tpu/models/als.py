@@ -284,27 +284,32 @@ def train_als(ratings: Ratings, config: ALSConfig, mesh=None, *,
     # would silently return a model of the wrong run
     fp = _run_fingerprint(ratings, config)
 
+    def _resumable(state) -> bool:
+        v_arr, u_arr = state.get("v"), state.get("u")
+        return (state.get("fp") is not None and int(state["fp"]) == fp
+                and v_arr is not None and u_arr is not None
+                and v_arr.shape == (ni, rank) and u_arr.shape == (nu, rank)
+                and int(state["it"]) <= config.iterations)
+
     start_it = 0
     v = None
     u_restored = None
     if checkpointer is not None:
-        restored = checkpointer.restore()
+        restored = checkpointer.restore_first_valid(_resumable)
         if restored is not None:
             ck_step, state = restored
-            v_arr, u_arr = state.get("v"), state.get("u")
-            if (state.get("fp") is not None and int(state["fp"]) == fp
-                    and v_arr is not None and u_arr is not None
-                    and v_arr.shape == (ni, rank) and u_arr.shape == (nu, rank)
-                    and int(state["it"]) <= config.iterations):
-                start_it = int(state["it"])
-                v = jax.device_put(jnp.asarray(v_arr), rep)
-                u_restored = jax.device_put(jnp.asarray(u_arr), rep)
-                log.info("resuming ALS from checkpoint step %d (iter %d)",
-                         ck_step, start_it)
-            else:
-                log.warning("checkpoint at step %s is from a different "
-                            "run (data/config fingerprint mismatch); "
-                            "starting fresh", ck_step)
+            start_it = int(state["it"])
+            v = jax.device_put(jnp.asarray(state["v"]), rep)
+            u_restored = jax.device_put(jnp.asarray(state["u"]), rep)
+            log.info("resuming ALS from checkpoint step %d (iter %d)",
+                     ck_step, start_it)
+        elif checkpointer.steps():
+            # only stale steps exist; purge them or retention would keep
+            # preferring them over this run's fresh (lower-numbered) saves
+            log.warning("no resumable checkpoint (data/config changed); "
+                        "clearing %d stale step(s) and starting fresh",
+                        len(checkpointer.steps()))
+            checkpointer.clear()
     if v is None:
         key = jax.random.PRNGKey(config.seed)
         _k_u, k_v = jax.random.split(key)

@@ -1,0 +1,73 @@
+"""Linear (ridge) regression — one normal-equation solve on the MXU.
+
+Analog of the reference's regression example engines, which fit ordinary
+least squares with nak's LinearRegression on breeze matrices (reference:
+examples/experimental/scala-local-regression/Run.scala:28-76,
+scala-parallel-regression/Run.scala). On TPU the whole fit is XᵀX (a
+single [F,N]×[N,F] matmul), a λ-ridge shift, and one cholesky solve —
+there is no iterative loop to distribute; X is data-sharded over the mesh
+and XLA psums the gramian over ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+__all__ = ["LinRegModel", "train_linreg"]
+
+
+@dataclasses.dataclass
+class LinRegModel:
+    weights: np.ndarray  # [F]
+    intercept: float
+
+    def predict(self, x: np.ndarray) -> np.ndarray:
+        x = np.atleast_2d(np.asarray(x, np.float32))
+        return x @ self.weights + self.intercept
+
+
+def train_linreg(
+    x: np.ndarray, y: np.ndarray, *, l2: float = 1e-6, mesh=None
+) -> LinRegModel:
+    """Ridge fit with an intercept column; l2 is not applied to the
+    intercept (matches the usual OLS behavior of the reference's nak fit
+    when l2→0)."""
+    import jax
+    import jax.numpy as jnp
+
+    x = np.asarray(x, np.float32)
+    y = np.asarray(y, np.float32)
+    if x.ndim != 2 or len(x) != len(y):
+        raise ValueError(f"bad shapes x{x.shape} y{y.shape}")
+    n, f = x.shape
+    if n == 0:
+        raise ValueError("empty training data")
+
+    # intercept column BEFORE padding: padded rows must be all-zero
+    # (including the intercept feature) so they truly contribute nothing
+    # to gram/rhs; n is the real count, not the padded one
+    xb = np.concatenate([x, np.ones((n, 1), np.float32)], axis=1)
+
+    @jax.jit
+    def fit(xd, yd):
+        gram = xd.T @ xd  # [F+1, F+1] — the MXU does all the work here
+        reg = l2 * jnp.eye(f + 1, dtype=xd.dtype).at[f, f].set(0.0)
+        rhs = xd.T @ yd
+        return jnp.linalg.solve(gram + reg * n, rhs)
+
+    if mesh is not None and n >= mesh.devices.size:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        pad = (-n) % mesh.devices.size
+        if pad:
+            xb = np.concatenate([xb, np.zeros((pad, f + 1), np.float32)])
+            y = np.concatenate([y, np.zeros(pad, np.float32)])
+        shard = NamedSharding(mesh, P("data", None))
+        xd = jax.device_put(xb, shard)
+        yd = jax.device_put(y, NamedSharding(mesh, P("data")))
+    else:
+        xd, yd = xb, y
+    w = np.asarray(fit(xd, yd))
+    return LinRegModel(weights=w[:f], intercept=float(w[f]))

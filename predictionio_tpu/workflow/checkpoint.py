@@ -44,8 +44,11 @@ class _OrbaxBackend:
         import orbax.checkpoint as ocp
 
         # orbax narrates every save at absl INFO — far too chatty for a
-        # CLI that checkpoints every few iterations
-        logging.getLogger("absl").setLevel(logging.WARNING)
+        # CLI that checkpoints every few iterations. Only quiet the absl
+        # logger if the application hasn't configured it itself.
+        absl_logger = logging.getLogger("absl")
+        if absl_logger.level == logging.NOTSET:
+            absl_logger.setLevel(logging.WARNING)
         self._ckptr = ocp.PyTreeCheckpointer()
 
     def save(self, path: Path, state: Any) -> None:
@@ -124,3 +127,24 @@ class TrainCheckpointer:
         if step is None:
             return None
         return step, self._backend.restore(self._step_dir(step))
+
+    def restore_first_valid(self, is_valid) -> tuple[int, Any] | None:
+        """Walk steps newest-first and return the first whose state passes
+        ``is_valid(state)`` — a stale higher-numbered step from an older
+        run must not shadow resumable ones."""
+        for step in reversed(self.steps()):
+            try:
+                state = self._backend.restore(self._step_dir(step))
+            except Exception as e:
+                log.warning("checkpoint step %d unreadable (%s); skipping", step, e)
+                continue
+            if is_valid(state):
+                return step, state
+            log.info("checkpoint step %d is from a different run; skipping", step)
+        return None
+
+    def clear(self) -> None:
+        """Drop every step (a fresh run starting over must not leave stale
+        steps that retention would preserve over its own)."""
+        for step in self.steps():
+            shutil.rmtree(self._step_dir(step), ignore_errors=True)

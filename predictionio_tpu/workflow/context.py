@@ -65,19 +65,26 @@ class Context:
         #: algorithms that support step-level resume read these
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = checkpoint_every
+        #: set by Engine.train around each algorithm's train() call —
+        #: namespaces per-algorithm state such as checkpoints
+        self.current_algorithm: str | None = None
         self._mesh = None
         self._mesh_shape = mesh_shape
         self._mesh_axes = mesh_axes
 
     def checkpointer(self, subdir: str = ""):
         """TrainCheckpointer for this run, or None when checkpointing is
-        off (no --checkpoint-dir)."""
+        off (no --checkpoint-dir). The path is namespaced by the algorithm
+        currently training (Engine.train sets ``current_algorithm``) so
+        multiple algorithm entries never clobber each other's steps."""
         if not self.checkpoint_dir:
             return None
         from .checkpoint import TrainCheckpointer
         from pathlib import Path
 
         d = Path(self.checkpoint_dir)
+        if self.current_algorithm:
+            d = d / self.current_algorithm.replace("/", "_")
         return TrainCheckpointer(d / subdir if subdir else d)
 
     # -- devices -----------------------------------------------------------

@@ -1,0 +1,146 @@
+"""Next-item engine — Markov chain over per-user event sequences.
+
+Builds a full DASE engine around the ``engine_lib`` MarkovChain (the
+analog of how reference engines consume the e2 library: e2/src/main/
+scala/io/prediction/e2/engine/MarkovChain.scala:201-260; its MLlib-style
+usage appears in the movielens-evaluation example,
+examples/experimental/scala-local-movielens-evaluation). Each user's
+``view`` events, ordered by event time, form a state sequence; adjacent
+pairs become transition counts; the model keeps each item's top-N next
+items by probability.
+
+Query:  {"item": "i3", "num": 2}
+Result: {"itemScores": [{"item": "i7", "score": 0.6}, ...]}
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    Params,
+    Preparator,
+    SanityCheck,
+)
+from predictionio_tpu.engine_lib import MarkovChainModel, train_markov_chain
+from predictionio_tpu.storage.bimap import BiMap
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "MyApp"
+    event_names: tuple = ("view",)
+
+
+@dataclass(frozen=True)
+class Query:
+    item: str = ""
+    num: int = 5
+
+
+@dataclass(frozen=True)
+class ItemScore:
+    item: str = ""
+    score: float = 0.0
+
+
+@dataclass(frozen=True)
+class PredictedResult:
+    itemScores: tuple = ()
+
+
+class Sequences(SanityCheck):
+    """Per-user item-row sequences + the item id map."""
+
+    def __init__(self, sequences: list[list[int]], item_ids: BiMap):
+        self.sequences = sequences
+        self.item_ids = item_ids
+
+    def sanity_check(self) -> None:
+        if not any(len(s) >= 2 for s in self.sequences):
+            raise ValueError("No user has >= 2 sequential events; "
+                             "a transition model needs pairs.")
+
+
+class SequenceDataSource(DataSource):
+    params_class = DataSourceParams
+
+    def read_training(self, ctx) -> Sequences:
+        store = ctx.event_store()
+        per_user: dict[str, list] = defaultdict(list)
+        for e in store.find(app_name=self.params.app_name,
+                            event_names=list(self.params.event_names),
+                            latest=False):
+            if e.target_entity_id is not None:
+                per_user[e.entity_id].append((e.event_time, e.target_entity_id))
+        items = sorted({iid for evs in per_user.values() for _, iid in evs})
+        item_ids = BiMap({iid: i for i, iid in enumerate(items)})
+        seqs = []
+        for evs in per_user.values():
+            evs.sort(key=lambda p: p[0])
+            seqs.append([item_ids[iid] for _, iid in evs])
+        return Sequences(seqs, item_ids)
+
+
+class SequencePreparator(Preparator):
+    """Sequences -> COO transition counts (the CoordinateMatrix build in
+    the reference's MarkovChain usage)."""
+
+    def prepare(self, ctx, td: Sequences):
+        counts: dict[tuple[int, int], int] = defaultdict(int)
+        for seq in td.sequences:
+            for a, b in zip(seq, seq[1:]):
+                counts[(a, b)] += 1
+        if counts:
+            keys = np.asarray(list(counts.keys()), np.int64)
+            frm, to = keys[:, 0], keys[:, 1]
+            cnt = np.asarray(list(counts.values()), np.float64)
+        else:
+            frm = to = np.zeros(0, np.int64)
+            cnt = np.zeros(0, np.float64)
+        return {"from": frm, "to": to, "counts": cnt,
+                "n_states": len(td.item_ids), "item_ids": td.item_ids}
+
+
+@dataclass(frozen=True)
+class MarkovParams(Params):
+    top_n: int = 10
+
+
+class MarkovAlgorithm(Algorithm):
+    params_class = MarkovParams
+    query_class = Query
+
+    def train(self, ctx, pd) -> tuple[MarkovChainModel, BiMap]:
+        model = train_markov_chain(
+            pd["from"], pd["to"], pd["counts"], pd["n_states"],
+            top_n=self.params.top_n,
+        )
+        return model, pd["item_ids"]
+
+    def predict(self, model_and_ids, query: Query) -> PredictedResult:
+        model, item_ids = model_and_ids
+        row = item_ids.get(query.item)
+        if row is None:
+            return PredictedResult()
+        inv = item_ids.inverse
+        pairs = model.predict(row)[: query.num]
+        return PredictedResult(itemScores=tuple(
+            ItemScore(item=inv[j], score=float(p)) for j, p in pairs
+        ))
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_classes=SequenceDataSource,
+        preparator_classes=SequencePreparator,
+        algorithm_classes={"markov": MarkovAlgorithm},
+        serving_classes=FirstServing,
+    )

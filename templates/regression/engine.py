@@ -1,0 +1,129 @@
+"""Regression engine template — ridge regression on event-property features.
+
+Analog of the reference's regression example engines (experimental:
+examples/experimental/scala-local-regression/Run.scala — LDataSource
+reading (features, label) rows, nak LinearRegression fit, MeanSquareError
+eval; parallel variant scala-parallel-regression/Run.scala). Here ``$set``
+events define per-entity numeric features plus a numeric target; the fit
+is one MXU normal-equation solve (models/linreg.py).
+
+Query:  {"features": [0.2, 1.4]}
+Result: {"prediction": 3.1}
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    Params,
+    Preparator,
+    SanityCheck,
+)
+from predictionio_tpu.models.linreg import train_linreg
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "MyApp"
+    entity_type: str = "point"
+    attrs: tuple = ("x0", "x1")
+    target: str = "y"
+    eval_k: int = 0
+
+
+@dataclass(frozen=True)
+class Query:
+    features: tuple = ()
+
+
+@dataclass(frozen=True)
+class PredictedResult:
+    prediction: float = 0.0
+
+
+class RegressionData(SanityCheck):
+    def __init__(self, x: np.ndarray, y: np.ndarray):
+        self.x = x
+        self.y = y
+
+    def sanity_check(self) -> None:
+        if len(self.y) == 0:
+            raise ValueError("No labeled points found; import data first.")
+
+
+class RegressionDataSource(DataSource):
+    """(reference LocalDataSource.read, scala-local-regression/Run.scala:
+    37-56: file rows -> (features, target); here: $set aggregation)"""
+
+    params_class = DataSourceParams
+
+    def _data(self, ctx) -> RegressionData:
+        store = ctx.event_store()
+        props = store.aggregate_properties(
+            app_name=self.params.app_name, entity_type=self.params.entity_type,
+            required=[*self.params.attrs, self.params.target],
+        )
+        xs, ys = [], []
+        for _eid, pm in props.items():
+            xs.append([float(pm.get(a)) for a in self.params.attrs])
+            ys.append(float(pm.get(self.params.target)))
+        x = np.asarray(xs, np.float32).reshape(-1, len(self.params.attrs))
+        return RegressionData(x, np.asarray(ys, np.float32))
+
+    def read_training(self, ctx) -> RegressionData:
+        return self._data(ctx)
+
+    def read_eval(self, ctx):
+        full = self._data(ctx)
+        k = self.params.eval_k
+        if k <= 1:
+            return []
+        idx = np.arange(len(full.y))
+        folds = []
+        for fold in range(k):
+            test = (idx % k) == fold
+            td = RegressionData(full.x[~test], full.y[~test])
+            qa = [
+                (Query(features=tuple(full.x[i].tolist())), float(full.y[i]))
+                for i in np.nonzero(test)[0]
+            ]
+            folds.append((td, {"fold": fold}, qa))
+        return folds
+
+
+class RegressionPreparator(Preparator):
+    def prepare(self, ctx, td: RegressionData) -> RegressionData:
+        return td
+
+
+@dataclass(frozen=True)
+class RidgeParams(Params):
+    l2: float = 1e-6
+
+
+class RidgeAlgorithm(Algorithm):
+    params_class = RidgeParams
+    query_class = Query
+
+    def train(self, ctx, pd: RegressionData):
+        return train_linreg(pd.x, pd.y, l2=self.params.l2, mesh=ctx.mesh)
+
+    def predict(self, model, query: Query) -> PredictedResult:
+        x = np.asarray(query.features, np.float32)
+        return PredictedResult(prediction=float(model.predict(x)[0]))
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_classes=RegressionDataSource,
+        preparator_classes=RegressionPreparator,
+        algorithm_classes={"ridge": RidgeAlgorithm},
+        serving_classes=FirstServing,
+    )

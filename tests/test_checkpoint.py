@@ -127,6 +127,28 @@ class TestALSResume:
         np.testing.assert_allclose(m.item_factors, m_fresh.item_factors,
                                    rtol=1e-5, atol=1e-5)
 
+    def test_stale_high_step_does_not_shadow(self, tmp_path):
+        # a leftover step_10 from an older (different-data) run must not
+        # permanently disable resume: it is skipped, purged, and the new
+        # run's own lower-numbered steps take over
+        ck = TrainCheckpointer(tmp_path / "als")
+        cfg = ALSConfig(rank=8, iterations=3, lambda_=0.1, seed=5)
+        ck.save(10, {"u": np.zeros((40, 8), np.float32),
+                     "v": np.zeros((30, 8), np.float32),
+                     "it": np.int64(10), "fp": np.uint64(12345)})
+        r = _ratings(seed=1)
+        m = train_als(r, cfg, checkpointer=ck, checkpoint_every=1)
+        m_fresh = train_als(r, cfg)
+        np.testing.assert_allclose(m.item_factors, m_fresh.item_factors,
+                                   rtol=1e-5, atol=1e-5)
+        assert 10 not in ck.steps() and ck.latest_step() == 3
+        # and a subsequent resume works again
+        cfg6 = ALSConfig(rank=8, iterations=6, lambda_=0.1, seed=5)
+        m6 = train_als(r, cfg6, checkpointer=ck, checkpoint_every=1)
+        m6_fresh = train_als(r, cfg6)
+        np.testing.assert_allclose(m6.item_factors, m6_fresh.item_factors,
+                                   rtol=1e-5, atol=1e-5)
+
     def test_extend_iterations_resumes(self, tmp_path):
         r = _ratings()
         ck = TrainCheckpointer(tmp_path / "als")

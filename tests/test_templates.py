@@ -212,3 +212,106 @@ class TestSeqRec:
         out = algo.predict(model, mod.Query(user="u0", num=2))
         assert out.itemScores
         assert out.itemScores[0].item == "i4"
+
+
+class TestRegression:
+    def test_train_and_predict(self, rng, mesh8):
+        mod = load_template("regression")
+        app = setup_app()
+        # y = 2*x0 - 3*x1 + 1 + noise
+        w = np.array([2.0, -3.0])
+        for i in range(80):
+            x = rng.normal(size=2)
+            y = float(x @ w + 1.0 + rng.normal(scale=0.01))
+            insert(app.id, event="$set", entity_type="point",
+                   entity_id=f"p{i}",
+                   props={"x0": float(x[0]), "x1": float(x[1]), "y": y})
+        engine = mod.engine_factory()
+        ep = EngineParams(
+            data_source_params=("", mod.DataSourceParams(app_name="MyApp")),
+            algorithm_params_list=(("ridge", mod.RidgeParams()),),
+        )
+        result = engine.train(Context(), ep)
+        algo, model = result.algorithms[0], result.models[0]
+        pred = algo.predict(model, mod.Query(features=(1.0, 1.0))).prediction
+        assert abs(pred - (2.0 - 3.0 + 1.0)) < 0.1
+        assert np.allclose(model.weights, w, atol=0.05)
+
+    def test_eval_folds(self, rng, mesh8):
+        mod = load_template("regression")
+        app = setup_app()
+        for i in range(30):
+            x = rng.normal(size=2)
+            insert(app.id, event="$set", entity_type="point",
+                   entity_id=f"p{i}",
+                   props={"x0": float(x[0]), "x1": float(x[1]),
+                          "y": float(x.sum())})
+        ds = mod.RegressionDataSource(mod.DataSourceParams(app_name="MyApp", eval_k=3))
+        folds = ds.read_eval(Context())
+        assert len(folds) == 3
+        td, _ei, qa = folds[0]
+        assert len(td.y) + len(qa) == 30
+
+
+class TestFriendRecommendation:
+    def test_similarity_and_acceptance(self, mesh8):
+        mod = load_template("friendrecommendation")
+        app = setup_app()
+        insert(app.id, event="$set", entity_type="user", entity_id="u1",
+               props={"keywords": {"music": 0.9, "sports": 0.1}})
+        insert(app.id, event="$set", entity_type="user", entity_id="u2",
+               props={"keywords": {"cooking": 1.0}})
+        insert(app.id, event="$set", entity_type="item", entity_id="i1",
+               props={"keywords": {"music": 0.8}})
+        insert(app.id, event="$set", entity_type="item", entity_id="i2",
+               props={"keywords": {"sports": 0.5, "cooking": 0.5}})
+        # invites teach the acceptance threshold
+        insert(app.id, event="invite", entity_type="user", entity_id="u1",
+               target_entity_type="item", target_entity_id="i1",
+               props={"accepted": True})
+        insert(app.id, event="invite", entity_type="user", entity_id="u2",
+               target_entity_type="item", target_entity_id="i1",
+               props={"accepted": False})
+        engine = mod.engine_factory()
+        ep = EngineParams(
+            data_source_params=("", mod.DataSourceParams(app_name="MyApp")),
+            algorithm_params_list=(("keywordsim", mod.KeywordSimParams()),),
+        )
+        result = engine.train(Context(), ep)
+        algo, model = result.algorithms[0], result.models[0]
+        strong = algo.predict(model, mod.Query(user="u1", item="i1"))
+        weak = algo.predict(model, mod.Query(user="u2", item="i1"))
+        assert strong.confidence == pytest.approx(0.9 * 0.8)
+        assert weak.confidence == 0.0
+        assert strong.confidence > weak.confidence
+        # unseen entities -> zero-confidence fallback, not an error
+        unseen = algo.predict(model, mod.Query(user="nobody", item="i1"))
+        assert unseen.confidence == 0.0 and not unseen.acceptance
+
+
+class TestMarkovChain:
+    def test_next_item(self, mesh8):
+        from datetime import datetime, timedelta, timezone
+
+        mod = load_template("markovchain")
+        app = setup_app()
+        t0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+        # deterministic cycle i0 -> i1 -> i2 (3 users repeat it)
+        for u in range(3):
+            for t in range(6):
+                insert(app.id, event="view", entity_type="user",
+                       entity_id=f"u{u}", target_entity_type="item",
+                       target_entity_id=f"i{t % 3}",
+                       event_time=t0 + timedelta(minutes=t))
+        engine = mod.engine_factory()
+        ep = EngineParams(
+            data_source_params=("", mod.DataSourceParams(app_name="MyApp")),
+            algorithm_params_list=(("markov", mod.MarkovParams(top_n=2)),),
+        )
+        result = engine.train(Context(), ep)
+        algo, model = result.algorithms[0], result.models[0]
+        out = algo.predict(model, mod.Query(item="i0", num=2))
+        assert out.itemScores[0].item == "i1"
+        assert out.itemScores[0].score == pytest.approx(1.0)
+        # unseen item -> empty result
+        assert algo.predict(model, mod.Query(item="zzz")).itemScores == ()
