@@ -7,6 +7,8 @@ P/L split collapses on TPU).
 
 from .components import (
     Algorithm,
+    CustomQuerySerializer,
+    LocalFileSystemPersistentModel,
     AverageServing,
     DataSource,
     Doer,
@@ -21,6 +23,7 @@ from .engine import Engine, EngineFactory, EvalFold, TrainResult
 from .evaluation import (
     EngineParamsGenerator,
     Evaluation,
+    Evaluator,
     MetricEvaluator,
     MetricEvaluatorResult,
     MetricScores,
@@ -40,7 +43,8 @@ from .params import EmptyParams, EngineParams, Params, parse_params, params_to_j
 __all__ = [
     "Algorithm", "AverageMetric", "AverageServing", "DataSource", "Doer",
     "EmptyParams", "Engine", "EngineFactory", "EngineParams",
-    "EngineParamsGenerator", "EvalFold", "Evaluation", "FastEvalEngine",
+    "CustomQuerySerializer", "EngineParamsGenerator", "EvalFold", "Evaluation",
+    "Evaluator", "FastEvalEngine", "LocalFileSystemPersistentModel",
     "FirstServing", "IdentityPreparator", "Metric", "MetricEvaluator",
     "MetricEvaluatorResult", "MetricScores", "OptionAverageMetric",
     "OptionStdevMetric", "Params", "PersistentModel", "Preparator",

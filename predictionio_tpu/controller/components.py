@@ -147,6 +147,45 @@ class PersistentModel(abc.ABC):
         ...
 
 
+class LocalFileSystemPersistentModel(PersistentModel):
+    """Pickle-to-disk PersistentModel helper (reference: controller/
+    LocalFileSystemPersistentModel.scala saves via the local FS; here the
+    path is ``$PIO_HOME/pmodels/<class>-<instance_id>.pkl``)."""
+
+    @classmethod
+    def _path(cls, instance_id: str):
+        from ..storage.registry import Storage
+
+        d = Storage.home() / "pmodels"
+        d.mkdir(parents=True, exist_ok=True)
+        return d / f"{cls.__name__}-{instance_id}.pkl"
+
+    def save(self, instance_id: str, params: Any) -> bool:
+        import pickle
+
+        with open(self._path(instance_id), "wb") as f:
+            pickle.dump(self, f)
+        return True
+
+    @classmethod
+    def load(cls, instance_id: str, params: Any, ctx) -> "LocalFileSystemPersistentModel":
+        import pickle
+
+        with open(cls._path(instance_id), "rb") as f:
+            return pickle.load(f)
+
+
+class CustomQuerySerializer:
+    """Opt-in query-decoding override (reference: controller/
+    CustomQuerySerializer.scala lets engines register json4s serializers
+    for exotic query shapes). An Algorithm inheriting this — or simply
+    defining ``decode_query`` — takes over JSON->Query conversion on the
+    serving hot path instead of the default dataclass parse."""
+
+    def decode_query(self, query_json: dict) -> Any:
+        raise NotImplementedError
+
+
 class SanityCheck(abc.ABC):
     """Opt-in data sanity hook called on TD/PD/models during train
     (reference: controller/SanityCheck.scala; invoked Engine.scala:610-666)."""

@@ -161,6 +161,16 @@ class Engine(Generic[TD, EI, PD, Q, P, A]):
         names, algos = self.make_algorithms(engine_params)
         serving = self.make_serving(engine_params)
 
+        # mid-training checkpointing is a deploy-train feature; eval trains
+        # many short-lived models across folds/variants that would collide
+        # in (and destructively clear) one checkpoint directory
+        saved_ck, ctx.checkpoint_dir = ctx.checkpoint_dir, None
+        try:
+            return self._eval_folds(ctx, folds, preparator, algos, serving)
+        finally:
+            ctx.checkpoint_dir = saved_ck
+
+    def _eval_folds(self, ctx, folds, preparator, algos, serving) -> list[EvalFold]:
         out: list[EvalFold] = []
         for fold_idx, (td, eval_info, qa) in enumerate(folds):
             pd = preparator.prepare(ctx, td)

@@ -20,9 +20,35 @@ from .params import EngineParams
 log = logging.getLogger("predictionio_tpu.evaluation")
 
 __all__ = [
-    "Evaluation", "EngineParamsGenerator", "MetricEvaluator",
+    "Evaluation", "EngineParamsGenerator", "Evaluator", "MetricEvaluator",
     "MetricScores", "MetricEvaluatorResult",
 ]
+
+
+class Evaluator:
+    """Legacy three-level evaluation API (reference: controller/
+    Evaluator.scala:140 — evaluateUnit per (Q,P,A), evaluateSet per fold,
+    evaluateAll across folds). Prefer Metric/MetricEvaluator; kept for
+    ported engines.
+
+    Subclass and override the three levels; ``evaluate`` drives them over
+    one engine-params variant's eval folds."""
+
+    def evaluate_unit(self, query: Any, prediction: Any, actual: Any) -> Any:
+        raise NotImplementedError
+
+    def evaluate_set(self, eval_info: Any, units: Sequence[Any]) -> Any:
+        raise NotImplementedError
+
+    def evaluate_all(self, sets: Sequence[tuple[Any, Any]]) -> Any:
+        raise NotImplementedError
+
+    def evaluate(self, folds: Sequence[EvalFold]) -> Any:
+        sets = []
+        for fold in folds:
+            units = [self.evaluate_unit(q, p, a) for q, p, a in fold.qpa]
+            sets.append((fold.eval_info, self.evaluate_set(fold.eval_info, units)))
+        return self.evaluate_all(sets)
 
 
 class EngineParamsGenerator:

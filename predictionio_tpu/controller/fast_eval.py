@@ -85,8 +85,14 @@ class FastEvalEngine(Engine):
         return self._algo_cache[k]
 
     def eval(self, ctx, engine_params: EngineParams) -> list[EvalFold]:
-        prepared = self._prepared(ctx, engine_params)
-        per_fold_models = self._models(ctx, engine_params, prepared)
+        # same policy as Engine.eval: no mid-training checkpoints for the
+        # many short-lived eval trains (they would collide in one dir)
+        saved_ck, ctx.checkpoint_dir = ctx.checkpoint_dir, None
+        try:
+            prepared = self._prepared(ctx, engine_params)
+            per_fold_models = self._models(ctx, engine_params, prepared)
+        finally:
+            ctx.checkpoint_dir = saved_ck
         _names, algos = self.make_algorithms(engine_params)
         serving = self.make_serving(engine_params)
         out: list[EvalFold] = []

@@ -135,10 +135,13 @@ class TrainCheckpointer:
         for step in reversed(self.steps()):
             try:
                 state = self._backend.restore(self._step_dir(step))
+                ok = bool(is_valid(state))
             except Exception as e:
-                log.warning("checkpoint step %d unreadable (%s); skipping", step, e)
+                # unreadable step OR a foreign state shape the validator
+                # chokes on — either way, skip it, don't abort the walk
+                log.warning("checkpoint step %d unusable (%s); skipping", step, e)
                 continue
-            if is_valid(state):
+            if ok:
                 return step, state
             log.info("checkpoint step %d is from a different run; skipping", step)
         return None

@@ -119,8 +119,14 @@ class EngineServer:
         predictions = []
         first_q = query_json
         for i, (algo, model) in enumerate(zip(result.algorithms, result.models)):
-            qcls = getattr(algo, "query_class", None)
-            q = parse_params(qcls, query_json) if qcls is not None else query_json
+            decode = getattr(algo, "decode_query", None)
+            if decode is not None:
+                # CustomQuerySerializer hook (reference: controller/
+                # CustomQuerySerializer.scala) — engine-defined decoding
+                q = decode(query_json)
+            else:
+                qcls = getattr(algo, "query_class", None)
+                q = parse_params(qcls, query_json) if qcls is not None else query_json
             if i == 0:
                 first_q = q
             predictions.append(algo.predict(model, q))

@@ -111,7 +111,10 @@ class FriendDataSource(DataSource):
         item_ids, item_kw = densify(item_maps)
 
         records = []
-        for e in store.find(app_name=p.app_name, event_names=[p.invite_event]):
+        # chronological order: the perceptron update is order-sensitive
+        # (the reference walks trainingRecord in data order)
+        for e in store.find(app_name=p.app_name, event_names=[p.invite_event],
+                            latest=False):
             u = user_ids.get(e.entity_id)
             i = item_ids.get(e.target_entity_id)
             if u is not None and i is not None:
