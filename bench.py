@@ -46,7 +46,8 @@ def synth_ml20m(n: int, seed: int = 0):
     return users, items, vals
 
 
-def run_bench(n_ratings: int, iters: int, device_kind: str) -> dict:
+def run_bench(n_ratings: int, iters: int, device_kind: str,
+              compute_dtype: str = "float32") -> dict:
     import jax
 
     from predictionio_tpu.models.als import _put_buckets, make_train_step
@@ -80,7 +81,9 @@ def run_bench(n_ratings: int, iters: int, device_kind: str) -> dict:
     )
     log(f"[{device_kind}] device_put: {time.time()-t0:.1f}s on {jax.devices()[0].platform}")
 
-    step = make_train_step(mesh, rank=RANK, lambda_=0.1, nu=NU, ni=NI)
+    step = make_train_step(mesh, rank=RANK, lambda_=0.1, nu=NU, ni=NI,
+                           compute_dtype=compute_dtype)
+    log(f"[{device_kind}] compute_dtype={compute_dtype}")
 
     def pull(arr) -> np.ndarray:
         # On remote-execution platforms block_until_ready can return before
@@ -139,8 +142,49 @@ def cpu_floor() -> float:
     raise RuntimeError(f"cpu floor failed: {out.stdout[-500:]} {out.stderr[-500:]}")
 
 
+def accuracy_gate() -> float:
+    """The timed config (bf16 + inexact CG) must match the exact f32
+    solver's model quality before its speed counts: train twice on a
+    200k-rating subsample and compare reconstruction RMSE over observed
+    entries. Returns the RMSE gap; raises if it exceeds 1e-3."""
+    import jax.numpy as jnp
+
+    from predictionio_tpu.models.als import ALSConfig, train_als
+    from predictionio_tpu.storage.bimap import BiMap
+    from predictionio_tpu.storage.frame import Ratings
+
+    users, items, vals = synth_ml20m(200_000, seed=3)
+    nu, ni = int(users.max()) + 1, int(items.max()) + 1
+    r = Ratings(
+        user_indices=users.astype(np.int64), item_indices=items.astype(np.int64),
+        ratings=vals, user_ids=BiMap({f"u{i}": i for i in range(nu)}),
+        item_ids=BiMap({f"i{i}": i for i in range(ni)}),
+    )
+
+    def rmse(m):
+        pred = np.einsum("nr,nr->n", m.user_factors[users], m.item_factors[items])
+        return float(np.sqrt(np.mean((pred - vals) ** 2)))
+
+    base = dict(rank=RANK, iterations=3, lambda_=0.1, seed=5)
+    exact = rmse(train_als(r, ALSConfig(**base, solver="cholesky",
+                                        compute_dtype="float32")))
+    fast = rmse(train_als(r, ALSConfig(**base, solver="cg",
+                                       compute_dtype="bfloat16")))
+    gap = abs(fast - exact)
+    log(f"accuracy gate: exact-f32 RMSE {exact:.5f}, cg-bf16 RMSE {fast:.5f}, "
+        f"gap {gap:.2e}")
+    if gap > 1e-3:
+        raise AssertionError(f"cg/bf16 accuracy gap {gap:.2e} > 1e-3")
+    return gap
+
+
 def main() -> None:
-    result = run_bench(N_RATINGS, TIMED_ITERS, "chip")
+    # bf16 on the chip (half the gather traffic, MXU-rate einsums, f32
+    # accumulation + f32 solve); the CPU floor stays f32 — each substrate
+    # runs its natural best configuration. The accuracy gate above ties
+    # the fast config's model quality to the exact solver's.
+    gap = accuracy_gate()
+    result = run_bench(N_RATINGS, TIMED_ITERS, "chip", compute_dtype="bfloat16")
     value = result["iters_per_sec"]
     try:
         floor = cpu_floor()
@@ -154,6 +198,9 @@ def main() -> None:
         "value": round(value, 3),
         "unit": "iters/sec/chip",
         "vs_baseline": round(vs, 2),
+        "config": {"compute_dtype": "bfloat16", "solver": "cg",
+                   "accuracy_gap_rmse": round(gap, 6),
+                   "floor_config": "float32/cg"},
     }))
 
 

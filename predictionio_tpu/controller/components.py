@@ -178,12 +178,14 @@ class LocalFileSystemPersistentModel(PersistentModel):
 class CustomQuerySerializer:
     """Opt-in query-decoding override (reference: controller/
     CustomQuerySerializer.scala lets engines register json4s serializers
-    for exotic query shapes). An Algorithm inheriting this — or simply
-    defining ``decode_query`` — takes over JSON->Query conversion on the
-    serving hot path instead of the default dataclass parse."""
+    for exotic query shapes). An Algorithm defining ``decode_query(self,
+    query_json) -> Q`` takes over JSON->Query conversion on the serving
+    hot path instead of the default dataclass parse.
 
-    def decode_query(self, query_json: dict) -> Any:
-        raise NotImplementedError
+    Deliberately a pure marker with NO default ``decode_query``: the
+    server detects the hook with getattr, and an inherited always-raising
+    stub would turn a forgotten override into a serving outage instead of
+    the default parse."""
 
 
 class SanityCheck(abc.ABC):

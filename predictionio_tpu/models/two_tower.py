@@ -78,15 +78,7 @@ class TwoTowerModel(RetrievalServingMixin):
         row = self.user_ids.get(user_id)
         if row is None:
             return []
-        inv = self.item_ids.inverse
-        via_device = self._retriever_topk(self.user_embeddings[row], num, inv)
-        if via_device is not None:
-            return via_device
-        scores = self.item_embeddings @ self.user_embeddings[row]
-        num = min(num, len(scores))
-        top = np.argpartition(-scores, num - 1)[:num]
-        top = top[np.argsort(-scores[top])]
-        return [(inv[int(i)], float(scores[i])) for i in top]
+        return self.top_n_from_catalog(self.user_embeddings[row], num)
 
 
 def train_two_tower(ratings: Ratings, cfg: TwoTowerConfig, mesh=None) -> TwoTowerModel:

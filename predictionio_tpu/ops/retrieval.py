@@ -132,6 +132,42 @@ def _build_call(B, D, N_pad, n_total, k, tile_n, interpret):
     return jax.jit(call)
 
 
+def _pad_items(items: np.ndarray, n_total: int, tile_n: int) -> tuple[np.ndarray, int]:
+    """Feature-pad to the 128-lane width and row-pad to whole tiles;
+    returns (padded items, clamped tile_n)."""
+    it = _pad_to(items, 128, 1)
+    tile_n = min(tile_n, max(128, ((n_total + 127) // 128) * 128))
+    return _pad_to(it, tile_n, 0), tile_n
+
+
+def _run_topk(q: np.ndarray, items_dev, n_total: int, k: int, tile_n: int,
+              interpret: bool):
+    """Shared query-side prep + kernel call + un-pad for ``topk_scores``
+    and ``DeviceRetriever.topk`` (one home so padding/empty-catalog
+    handling cannot drift between the two entry points)."""
+    import jax.numpy as jnp
+
+    single = q.ndim == 1
+    if single:
+        q = q[None, :]
+    k_eff = min(k, n_total)
+    if n_total == 0 or k_eff == 0:
+        empty_v = np.zeros((q.shape[0], 0), np.float32)
+        empty_i = np.zeros((q.shape[0], 0), np.int32)
+        return (empty_v[0], empty_i[0]) if single else (empty_v, empty_i)
+    b_orig = q.shape[0]
+    q = _pad_to(q, 8, 0)
+    q = _pad_to(q, 128, 1)
+    call = _build_call(
+        q.shape[0], items_dev.shape[1], items_dev.shape[0], n_total, k_eff,
+        tile_n, interpret,
+    )
+    vals, idx = call(jnp.asarray(q), items_dev)
+    vals = np.asarray(vals)[:b_orig]
+    idx = np.asarray(idx)[:b_orig]
+    return (vals[0], idx[0]) if single else (vals, idx)
+
+
 def topk_scores(queries, items, k: int, *, tile_n: int = 512, interpret=None):
     """Top-k inner-product retrieval: (values [B, k], indices [B, k]).
 
@@ -145,31 +181,10 @@ def topk_scores(queries, items, k: int, *, tile_n: int = 512, interpret=None):
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     q = np.asarray(queries, dtype=np.float32)
-    single = q.ndim == 1
-    if single:
-        q = q[None, :]
     it = np.asarray(items, dtype=np.float32)
-    n_total, d = it.shape
-    k_eff = min(k, n_total)
-    if n_total == 0 or k_eff == 0:
-        empty_v = np.zeros((q.shape[0], 0), np.float32)
-        empty_i = np.zeros((q.shape[0], 0), np.int32)
-        return (empty_v[0], empty_i[0]) if single else (empty_v, empty_i)
-
-    b_orig = q.shape[0]
-    q = _pad_to(q, 8, 0)
-    q = _pad_to(q, 128, 1)
-    it = _pad_to(it, 128, 1)
-    tile_n = min(tile_n, ((n_total + 127) // 128) * 128)
-    it = _pad_to(it, tile_n, 0)
-
-    call = _build_call(
-        q.shape[0], q.shape[1], it.shape[0], n_total, k_eff, tile_n, bool(interpret)
-    )
-    vals, idx = call(jnp.asarray(q), jnp.asarray(it))
-    vals = np.asarray(vals)[:b_orig]
-    idx = np.asarray(idx)[:b_orig]
-    return (vals[0], idx[0]) if single else (vals, idx)
+    n_total = it.shape[0]
+    it, tile_n = _pad_items(it, n_total, tile_n)
+    return _run_topk(q, jnp.asarray(it), n_total, k, tile_n, bool(interpret))
 
 
 class DeviceRetriever:
@@ -187,35 +202,14 @@ class DeviceRetriever:
         self._interpret = bool(interpret)
         it = np.asarray(items, dtype=np.float32)
         self.n_total, self.dim = it.shape
-        it = _pad_to(it, 128, 1)
-        self._tile_n = min(tile_n, max(128, ((self.n_total + 127) // 128) * 128))
-        it = _pad_to(it, self._tile_n, 0)
+        it, self._tile_n = _pad_items(it, self.n_total, tile_n)
         self._items = jax.device_put(jnp.asarray(it))
 
     def topk(self, queries, k: int):
         """(values [B, k], indices [B, k]) — indices -1 beyond catalog."""
-        import jax.numpy as jnp
-
         q = np.asarray(queries, dtype=np.float32)
-        single = q.ndim == 1
-        if single:
-            q = q[None, :]
-        k_eff = min(k, self.n_total)
-        if self.n_total == 0 or k_eff == 0:
-            empty_v = np.zeros((q.shape[0], 0), np.float32)
-            empty_i = np.zeros((q.shape[0], 0), np.int32)
-            return (empty_v[0], empty_i[0]) if single else (empty_v, empty_i)
-        b_orig = q.shape[0]
-        q = _pad_to(q, 8, 0)
-        q = _pad_to(q, 128, 1)
-        call = _build_call(
-            q.shape[0], self._items.shape[1], self._items.shape[0],
-            self.n_total, k_eff, self._tile_n, self._interpret,
-        )
-        vals, idx = call(jnp.asarray(q), self._items)
-        vals = np.asarray(vals)[:b_orig]
-        idx = np.asarray(idx)[:b_orig]
-        return (vals[0], idx[0]) if single else (vals, idx)
+        return _run_topk(q, self._items, self.n_total, k, self._tile_n,
+                         self._interpret)
 
 
 class RetrievalServingMixin:
@@ -229,6 +223,25 @@ class RetrievalServingMixin:
     """
 
     _retrieval_attr = "item_factors"
+    _retrieval_ids_attr = "item_ids"
+
+    def top_n_from_catalog(self, query_vec, num: int) -> list[tuple[str, float]]:
+        """[(id, score)] top-N of catalog·query: through the device
+        retriever when attached, else a host argpartition. The single
+        home of this logic for every retrieval-serving model."""
+        ids = getattr(self, self._retrieval_ids_attr)
+        inv = ids.inverse
+        via_device = self._retriever_topk(query_vec, num, inv)
+        if via_device is not None:
+            return via_device
+        catalog = getattr(self, self._retrieval_attr)
+        scores = catalog @ np.asarray(query_vec, catalog.dtype)
+        num = min(num, len(scores))
+        if num <= 0:
+            return []
+        top = np.argpartition(-scores, num - 1)[:num]
+        top = top[np.argsort(-scores[top])]
+        return [(inv[int(i)], float(scores[i])) for i in top]
 
     def attach_retriever(self, interpret=None) -> None:
         """Move the catalog device-resident and serve top-N through the

@@ -155,3 +155,49 @@ def test_degree_buckets_no_loss():
         assert len(set(real)) == len(real)  # no dup rows within a bucket
         covered.update(real.tolist())
     assert covered == set(range(num_rows))  # every row solved exactly once
+
+
+def test_solver_parity_cg_vs_exact(rng):
+    """CG (default, inexact inner solver) must reach the same model
+    quality as the exact cholesky/LU solvers — guards conditioning
+    regressions in the fast path (review finding: no parity coverage)."""
+    import dataclasses
+
+    ratings, full, mask = make_ratings(rng, nu=40, ni=30, rank=4, density=0.4)
+
+    base = ALSConfig(rank=8, iterations=8, lambda_=0.05, seed=3)
+
+    def rmse(m):
+        pred = m.user_factors @ m.item_factors.T
+        return float(np.sqrt(np.mean((pred[mask] - full[mask]) ** 2)))
+
+    scores = {}
+    for solver in ("cg", "cholesky", "lu"):
+        cfg = dataclasses.replace(base, solver=solver)
+        scores[solver] = rmse(train_als(ratings, cfg))
+    assert abs(scores["cg"] - scores["cholesky"]) < 1e-3, scores
+    assert abs(scores["cholesky"] - scores["lu"]) < 1e-4, scores
+
+
+def test_solver_parity_implicit(rng):
+    """Implicit-feedback path (plain-λ ridge, worse conditioning than
+    ALS-WR): CG factors must track the exact solver closely."""
+    import dataclasses
+
+    ratings, _full, _mask = make_ratings(rng, nu=30, ni=25, rank=4, density=0.5)
+    # implicit feedback is nonnegative (counts/strengths); negative values
+    # would make the confidence-weighted normal equations indefinite
+    ratings = Ratings(
+        user_indices=ratings.user_indices, item_indices=ratings.item_indices,
+        ratings=np.abs(ratings.ratings), user_ids=ratings.user_ids,
+        item_ids=ratings.item_ids,
+    )
+    base = ALSConfig(rank=8, iterations=6, lambda_=0.1, seed=3,
+                     implicit_prefs=True, alpha=5.0)
+    m_cg = train_als(ratings, dataclasses.replace(base, solver="cg"))
+    m_ex = train_als(ratings, dataclasses.replace(base, solver="cholesky"))
+    # compare predicted preference orderings via reconstruction closeness
+    p_cg = m_cg.user_factors @ m_cg.item_factors.T
+    p_ex = m_ex.user_factors @ m_ex.item_factors.T
+    denom = np.abs(p_ex).max() + 1e-9
+    assert np.max(np.abs(p_cg - p_ex)) / denom < 5e-3
