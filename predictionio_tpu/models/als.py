@@ -46,6 +46,11 @@ log = logging.getLogger("predictionio_tpu.als")
 
 __all__ = ["ALSModel", "ALSConfig", "train_als"]
 
+#: single source of truth for the CG inner-solver depth — ALSConfig, the
+#: bench, and direct make_train_step/_half_step callers must agree, or an
+#: accuracy gate could validate a different config than the timed one
+DEFAULT_CG_ITERS = 32
+
 
 @dataclasses.dataclass(frozen=True)
 class ALSConfig:
@@ -73,7 +78,7 @@ class ALSConfig:
     #: the alternation self-corrects across iterations — final model
     #: quality matches the exact solvers (see test_als solver parity).
     #: Raise for small-λ / ill-conditioned setups, or set solver="cholesky".
-    cg_iters: int = 32
+    cg_iters: int = DEFAULT_CG_ITERS
     seed: int = 7
 
 
@@ -154,7 +159,7 @@ def _run_fingerprint(ratings: Ratings, config: ALSConfig) -> int:
 # the pjit'd half-step
 # ---------------------------------------------------------------------------
 
-def _spd_solve(a, b, *, solver="cg", cg_iters=16):
+def _spd_solve(a, b, *, solver="cg", cg_iters=DEFAULT_CG_ITERS):
     """Batched SPD solve, [B, R, R] x [B, R].
 
     "cg": fixed-iteration conjugate gradient — every step is a batched
@@ -197,7 +202,7 @@ def _spd_solve(a, b, *, solver="cg", cg_iters=16):
 
 
 def _half_step(ids, vals, mask, other, *, lambda_, implicit, alpha, rank,
-               compute_dtype="float32", solver="cg", cg_iters=16):
+               compute_dtype="float32", solver="cg", cg_iters=DEFAULT_CG_ITERS):
     """Solve all rows of one side given the other side's factors.
 
     ids/vals/mask: [NB, B, D]; other: [NO, R] (replicated).
@@ -278,7 +283,7 @@ def _solve_side(buckets, other, out_rows, *, kw):
 def make_train_step(mesh, *, rank, lambda_=0.1, implicit=False, alpha=1.0,
                     nu=None, ni=None, model_sharded: bool = False,
                     compute_dtype: str = "float32", solver: str = "cg",
-                    cg_iters: int = 16):
+                    cg_iters: int = DEFAULT_CG_ITERS):
     """One full ALS iteration (user half-step + item half-step) over
     bucketed layouts as a single jitted function — the program the
     multi-chip dry-run compiles, and the inner loop of ``train_als``.

@@ -66,6 +66,7 @@ def _make_towers(n_users: int, n_items: int, cfg: TwoTowerConfig):
 @dataclasses.dataclass
 class TwoTowerModel(RetrievalServingMixin):
     _retrieval_attr = "item_embeddings"
+    _query_attr = "user_embeddings"
     user_params: Any
     item_params: Any
     user_embeddings: np.ndarray  # [NU, D] precomputed

@@ -102,8 +102,9 @@ def _topk_kernel(q_ref, items_ref, vals_ref, idx_ref, *, k, tile_n, n_total):
 
 @functools.partial(
     # bounded: a long-lived server reloading a growing catalog must not
-    # accumulate one compiled kernel per historical catalog size
-    functools.lru_cache(maxsize=16),
+    # accumulate one compiled kernel per historical catalog size. 32 covers
+    # the pow2-padded batch sizes x rounded k values of steady serving.
+    functools.lru_cache(maxsize=32),
 )
 def _build_call(B, D, N_pad, n_total, k, tile_n, interpret):
     import jax
@@ -151,20 +152,28 @@ def _run_topk(q: np.ndarray, items_dev, n_total: int, k: int, tile_n: int,
     if single:
         q = q[None, :]
     k_eff = min(k, n_total)
-    if n_total == 0 or k_eff == 0:
+    if n_total == 0 or k_eff <= 0:
         empty_v = np.zeros((q.shape[0], 0), np.float32)
         empty_i = np.zeros((q.shape[0], 0), np.int32)
         return (empty_v[0], empty_i[0]) if single else (empty_v, empty_i)
     b_orig = q.shape[0]
-    q = _pad_to(q, 8, 0)
+    # shape discipline on the serving hot path: batch padded to a power of
+    # two (>=8) and k rounded up to a multiple of 8, so traffic-dependent
+    # batch sizes / client-chosen num values map onto a handful of
+    # compiled kernels instead of one per (B, k) pair
+    b_pad = 8
+    while b_pad < q.shape[0]:
+        b_pad *= 2
+    q = _pad_to(q, b_pad, 0)
     q = _pad_to(q, 128, 1)
+    k_pad = min(((k_eff + 7) // 8) * 8, n_total)
     call = _build_call(
-        q.shape[0], items_dev.shape[1], items_dev.shape[0], n_total, k_eff,
+        q.shape[0], items_dev.shape[1], items_dev.shape[0], n_total, k_pad,
         tile_n, interpret,
     )
     vals, idx = call(jnp.asarray(q), items_dev)
-    vals = np.asarray(vals)[:b_orig]
-    idx = np.asarray(idx)[:b_orig]
+    vals = np.asarray(vals)[:b_orig, :k_eff]
+    idx = np.asarray(idx)[:b_orig, :k_eff]
     return (vals[0], idx[0]) if single else (vals, idx)
 
 
@@ -242,6 +251,53 @@ class RetrievalServingMixin:
         top = np.argpartition(-scores, num - 1)[:num]
         top = top[np.argsort(-scores[top])]
         return [(inv[int(i)], float(scores[i])) for i in top]
+
+    def top_n_batch(self, query_mat, num: int) -> list[list[tuple[str, float]]]:
+        """Batched ``top_n_from_catalog``: one fused device call (or one
+        host matmul) for a whole micro-batch of query vectors [B, D]."""
+        q = np.asarray(query_mat, np.float32)
+        if q.ndim != 2 or len(q) == 0:
+            return []
+        ids = getattr(self, self._retrieval_ids_attr)
+        inv = ids.inverse
+        retriever = getattr(self, "_retriever", None)
+        if retriever is not None:
+            vals, idx = retriever.topk(q, num)
+            return [
+                [(inv[int(i)], float(v)) for v, i in zip(vr, ir) if i >= 0]
+                for vr, ir in zip(vals, idx)
+            ]
+        catalog = getattr(self, self._retrieval_attr)
+        scores = q @ catalog.T  # [B, N]
+        k = min(num, scores.shape[1])
+        if k <= 0:
+            return [[] for _ in range(len(q))]
+        top = np.argpartition(-scores, k - 1, axis=1)[:, :k]
+        out = []
+        for r, t in zip(scores, top):
+            t = t[np.argsort(-r[t])]
+            out.append([(inv[int(i)], float(r[i])) for i in t])
+        return out
+
+    _query_attr = "user_factors"
+    _query_ids_attr = "user_ids"
+
+    def batch_recommend(self, users: list, nums: list) -> list[list[tuple[str, float]]]:
+        """Per-user top-N for a whole micro-batch in one device call;
+        unknown users get []. The single home of the unknown-user/kmax/
+        trim dance for every retrieval-serving model's batch_predict."""
+        uids = getattr(self, self._query_ids_attr)
+        qmat = getattr(self, self._query_attr)
+        out: list = [[] for _ in users]
+        known = [(j, uids.get(u)) for j, u in enumerate(users)]
+        known = [(j, r) for j, r in known if r is not None]
+        if not known:
+            return out
+        kmax = max(max(nums[j] for j, _ in known), 0)
+        recs = self.top_n_batch(qmat[[r for _, r in known]], kmax)
+        for (j, _r), rec in zip(known, recs):
+            out[j] = rec[: max(nums[j], 0)]
+        return out
 
     def attach_retriever(self, interpret=None) -> None:
         """Move the catalog device-resident and serve top-N through the

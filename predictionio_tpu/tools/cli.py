@@ -321,6 +321,8 @@ def cmd_deploy(args) -> int:
         port=args.port,
         feedback_url=args.event_server_url if args.feedback else None,
         access_key=args.accesskey,
+        batch_window_ms=args.batch_window_ms,
+        batch_max=args.batch_max,
     )
     return 0
 
@@ -499,6 +501,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--feedback", action="store_true")
     sp.add_argument("--event-server-url", default="http://localhost:7070")
     sp.add_argument("--accesskey")
+    sp.add_argument("--batch-window-ms", type=float, default=1.0,
+                    help="micro-batch window for concurrent queries "
+                         "(0 disables batching)")
+    sp.add_argument("--batch-max", type=int, default=64,
+                    help="max queries per micro-batch")
 
     sp = sub.add_parser("undeploy")
     sp.add_argument("--ip", default="localhost")

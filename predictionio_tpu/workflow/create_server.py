@@ -98,6 +98,8 @@ class EngineServer:
         *,
         feedback_url: str | None = None,
         access_key: str | None = None,
+        batch_window_ms: float = 1.0,
+        batch_max: int = 64,
     ):
         self.engine = engine
         self.ctx = ctx or Context(mode="Serving")
@@ -110,32 +112,96 @@ class EngineServer:
         self.avg_serving_sec = 0.0
         self.last_serving_sec = 0.0
         self._reload_lock = threading.Lock()  # serialize expensive reloads
+        # micro-batching dispatcher (workflow/microbatch.py): coalesce
+        # concurrent queries into fixed-shape batched device calls;
+        # window <= 0 disables (per-query dispatch, reference behavior)
+        self.batcher = None
+        if batch_window_ms > 0:
+            from .microbatch import MicroBatcher
+
+            self.batcher = MicroBatcher(
+                self.serve_query_batch,
+                max_batch=batch_max, window_s=batch_window_ms / 1000.0,
+            )
 
     # -- query hot path ----------------------------------------------------
+    @staticmethod
+    def _decode(algo, query_json: dict):
+        decode = getattr(algo, "decode_query", None)
+        if decode is not None:
+            # CustomQuerySerializer hook (reference: controller/
+            # CustomQuerySerializer.scala) — engine-defined decoding
+            return decode(query_json)
+        qcls = getattr(algo, "query_class", None)
+        return parse_params(qcls, query_json) if qcls is not None else query_json
+
     def serve_query(self, query_json: dict) -> dict:
+        """Single-query path (batching disabled)."""
+        tag, payload = self.serve_query_batch([query_json])[0]
+        if tag == "err":
+            raise payload
+        return payload
+
+    def serve_query_batch(self, query_jsons) -> list[tuple[str, Any]]:
+        """Serve a coalesced batch; one outcome ("ok", result) |
+        ("err", exception) PER query — a malformed query fails alone.
+
+        Each algorithm predicts its whole sub-batch through
+        ``batch_predict`` (retrieval models override it with one fused
+        device call); serving blends per query as usual.
+        """
         t0 = time.perf_counter()
         bundle = self.deployed  # snapshot reference (atomic swap safety)
         result = bundle.result
-        predictions = []
-        first_q = query_json
-        for i, (algo, model) in enumerate(zip(result.algorithms, result.models)):
-            decode = getattr(algo, "decode_query", None)
-            if decode is not None:
-                # CustomQuerySerializer hook (reference: controller/
-                # CustomQuerySerializer.scala) — engine-defined decoding
-                q = decode(query_json)
-            else:
-                qcls = getattr(algo, "query_class", None)
-                q = parse_params(qcls, query_json) if qcls is not None else query_json
-            if i == 0:
-                first_q = q
-            predictions.append(algo.predict(model, q))
-        served = result.serving.serve(first_q, predictions)
+        n = len(query_jsons)
+        errors: dict[int, Exception] = {}
+        first_qs: list[Any] = list(query_jsons)
+        per_algo: list[dict[int, Any]] = []
+        for ai, (algo, model) in enumerate(zip(result.algorithms, result.models)):
+            decoded: list[tuple[int, Any]] = []
+            for i, qj in enumerate(query_jsons):
+                if i in errors:
+                    continue
+                try:
+                    q = self._decode(algo, qj)
+                except Exception as e:  # noqa: BLE001 — per-query isolation
+                    errors[i] = e
+                    continue
+                if ai == 0:
+                    first_qs[i] = q
+                decoded.append((i, q))
+            preds: dict[int, Any] = {}
+            if decoded:
+                try:
+                    preds = dict(algo.batch_predict(model, decoded))
+                except Exception:  # noqa: BLE001
+                    # batch path failed; retry per query so one poison
+                    # query doesn't take down its whole batch
+                    log.exception("batch_predict failed; per-query fallback")
+                    for i, q in decoded:
+                        try:
+                            preds[i] = algo.predict(model, q)
+                        except Exception as e:  # noqa: BLE001
+                            errors[i] = e
+            per_algo.append(preds)
+
+        outcomes: list[tuple[str, Any]] = []
+        for i in range(n):
+            if i in errors:
+                outcomes.append(("err", errors[i]))
+                continue
+            try:
+                preds = [pa[i] for pa in per_algo]
+                served = result.serving.serve(first_qs[i], preds)
+                outcomes.append(("ok", _to_jsonable(served)))
+            except Exception as e:  # noqa: BLE001
+                outcomes.append(("err", e))
+
         dt = time.perf_counter() - t0
-        self.request_count += 1
-        self.last_serving_sec = dt
-        self.avg_serving_sec += (dt - self.avg_serving_sec) / self.request_count
-        return _to_jsonable(served)
+        self.request_count += n
+        self.last_serving_sec = dt / n
+        self.avg_serving_sec += (dt / n - self.avg_serving_sec) * n / self.request_count
+        return outcomes
 
     # -- hot reload (MasterActor ReloadServer, :315-336) -------------------
     def reload_latest(self) -> str:
@@ -167,6 +233,7 @@ class EngineServer:
             "avgServingSec": self.avg_serving_sec,
             "lastServingSec": self.last_serving_sec,
             "algorithms": [type(a).__name__ for a in self.deployed.result.algorithms],
+            **({"batching": self.batcher.stats()} if self.batcher else {}),
         }
 
     async def send_feedback(self, query_json: dict, prediction: dict, pr_id: str) -> None:
@@ -207,7 +274,10 @@ async def handle_query(request: web.Request) -> web.Response:
     if not isinstance(query_json, dict):
         return web.json_response({"message": "Query must be a JSON object."}, status=400)
     try:
-        result = await asyncio.to_thread(server.serve_query, query_json)
+        if server.batcher is not None:
+            result = await server.batcher.submit(query_json)
+        else:
+            result = await asyncio.to_thread(server.serve_query, query_json)
     except Exception as e:  # noqa: BLE001 — surface as 400 like the reference
         log.exception("query failed")
         return web.json_response({"message": str(e)}, status=400)

@@ -1,0 +1,126 @@
+"""Micro-batching query dispatcher — fixed-shape TPU serving under load.
+
+The reference serves each query independently on the Spark driver
+(reference: workflow/CreateServer.scala:462-591 — spray routes straight
+into ``algorithms.map(_.predictBase(...))``); per-query dispatch is fine
+on a JVM, but on a TPU each device call has a fixed launch overhead and
+the fused retrieval kernel (ops/retrieval.py) amortizes it over a query
+batch. This dispatcher coalesces concurrent ``/queries.json`` requests
+into one batched serve call:
+
+- first arrival opens a window (default 1 ms); everything arriving within
+  it (up to ``max_batch``) is served as ONE batch;
+- per-query failures are isolated — one malformed query 400s alone, the
+  rest of its batch still answers;
+- an idle server adds at most the window to p50; a loaded server turns N
+  device calls into ceil(N/max_batch).
+
+The batch function contract: ``batch_fn(list[query]) -> list[("ok",
+result) | ("err", exception)]``, run in a worker thread.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Callable, Sequence
+
+log = logging.getLogger("predictionio_tpu.server")
+
+__all__ = ["MicroBatcher"]
+
+
+class MicroBatcher:
+    """Coalesces concurrent submissions into batched calls."""
+
+    def __init__(
+        self,
+        batch_fn: Callable[[Sequence[Any]], list],
+        *,
+        max_batch: int = 64,
+        window_s: float = 0.001,
+    ):
+        self.batch_fn = batch_fn
+        self.max_batch = max(1, max_batch)
+        self.window_s = max(0.0, window_s)
+        self._pending: list[tuple[Any, asyncio.Future]] = []
+        self._wake: asyncio.Event | None = None
+        self._task: asyncio.Task | None = None
+        # observability: how well batching is working
+        self.batches = 0
+        self.batched_queries = 0
+        self.max_seen_batch = 0
+
+    def _ensure_started(self) -> None:
+        if self._task is None or self._task.done():
+            self._wake = asyncio.Event()
+            self._task = asyncio.create_task(self._run())
+
+    async def submit(self, query: Any) -> Any:
+        """Enqueue one query; resolves to its result (or raises its own
+        error) when its batch completes."""
+        self._ensure_started()
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending.append((query, fut))
+        assert self._wake is not None
+        self._wake.set()
+        return await fut
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._task = None
+        # fail anything still queued — a caller awaiting submit() must not
+        # hang forever because shutdown won the race with its batch
+        pending, self._pending = self._pending, []
+        for _, fut in pending:
+            if not fut.done():
+                fut.set_exception(asyncio.CancelledError("batcher closed"))
+
+    async def _run(self) -> None:
+        assert self._wake is not None
+        while True:
+            await self._wake.wait()
+            if self.window_s > 0 and len(self._pending) < self.max_batch:
+                # window open: let concurrent requests pile in
+                await asyncio.sleep(self.window_s)
+            batch = self._pending[: self.max_batch]
+            del self._pending[: len(batch)]
+            if not self._pending:
+                self._wake.clear()
+            if not batch:
+                continue
+            queries = [q for q, _ in batch]
+            try:
+                outcomes = await asyncio.to_thread(self.batch_fn, queries)
+                if len(outcomes) != len(batch):
+                    raise RuntimeError(
+                        f"batch_fn returned {len(outcomes)} outcomes for "
+                        f"{len(batch)} queries")
+            except Exception as e:  # noqa: BLE001 — batch-level failure
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)
+                continue
+            self.batches += 1
+            self.batched_queries += len(batch)
+            self.max_seen_batch = max(self.max_seen_batch, len(batch))
+            for (_, fut), (tag, payload) in zip(batch, outcomes):
+                if fut.done():
+                    continue
+                if tag == "ok":
+                    fut.set_result(payload)
+                else:
+                    fut.set_exception(payload)
+
+    def stats(self) -> dict:
+        return {
+            "batches": self.batches,
+            "batchedQueries": self.batched_queries,
+            "avgBatchSize": (self.batched_queries / self.batches) if self.batches else 0.0,
+            "maxBatchSize": self.max_seen_batch,
+        }

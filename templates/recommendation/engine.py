@@ -162,6 +162,18 @@ class ALSAlgorithm(Algorithm):
             itemScores=tuple(ItemScore(item=i, score=s) for i, s in recs)
         )
 
+    def batch_predict(self, model: ALSModel, queries) -> list:
+        """Micro-batched serving: one fused top-k device call for the
+        whole batch (the dispatcher in workflow/microbatch.py feeds this;
+        per-query predict gathers + launches per request instead)."""
+        recs = model.batch_recommend([q.user for _, q in queries],
+                                     [q.num for _, q in queries])
+        return [
+            (i, PredictedResult(itemScores=tuple(
+                ItemScore(item=t, score=s) for t, s in rec)))
+            for (i, _q), rec in zip(queries, recs)
+        ]
+
 
 def engine_factory() -> Engine:
     return Engine(

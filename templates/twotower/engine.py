@@ -107,6 +107,16 @@ class TwoTowerAlgorithm(Algorithm):
         )
         return train_two_tower(ratings, cfg, mesh=ctx.mesh)
 
+    def batch_predict(self, model: TwoTowerModel, queries) -> list:
+        """One fused top-k device call for the whole micro-batch."""
+        recs = model.batch_recommend([q.user for _, q in queries],
+                                     [q.num for _, q in queries])
+        return [
+            (i, PredictedResult(itemScores=tuple(
+                ItemScore(item=t, score=s) for t, s in rec)))
+            for (i, _q), rec in zip(queries, recs)
+        ]
+
     def predict(self, model: TwoTowerModel, query: Query) -> PredictedResult:
         recs = model.recommend_products(query.user, query.num)
         return PredictedResult(

@@ -1,0 +1,191 @@
+"""Micro-batching query dispatcher (workflow/microbatch.py) + the batched
+serving path (EngineServer.serve_query_batch, template batch_predict
+overrides). SURVEY §7 hard part (f): fixed-shape batched TPU calls under
+concurrent load without recompilation."""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from predictionio_tpu.workflow.microbatch import MicroBatcher
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+class TestMicroBatcher:
+    def test_coalesces_concurrent_submissions(self):
+        calls = []
+
+        def batch_fn(queries):
+            calls.append(len(queries))
+            return [("ok", q * 2) for q in queries]
+
+        async def main():
+            mb = MicroBatcher(batch_fn, max_batch=64, window_s=0.01)
+            results = await asyncio.gather(*[mb.submit(i) for i in range(20)])
+            await mb.close()
+            return results
+
+        results = run(main())
+        assert results == [i * 2 for i in range(20)]
+        assert max(calls) > 1  # actually batched
+        assert sum(calls) == 20
+
+    def test_respects_max_batch(self):
+        calls = []
+
+        def batch_fn(queries):
+            calls.append(len(queries))
+            return [("ok", q) for q in queries]
+
+        async def main():
+            mb = MicroBatcher(batch_fn, max_batch=4, window_s=0.01)
+            out = await asyncio.gather(*[mb.submit(i) for i in range(10)])
+            await mb.close()
+            return out
+
+        assert run(main()) == list(range(10))
+        assert max(calls) <= 4
+
+    def test_per_query_error_isolation(self):
+        def batch_fn(queries):
+            return [("err", ValueError(f"bad {q}")) if q == 3 else ("ok", q)
+                    for q in queries]
+
+        async def main():
+            mb = MicroBatcher(batch_fn, max_batch=64, window_s=0.005)
+            futs = await asyncio.gather(
+                *[mb.submit(i) for i in range(6)], return_exceptions=True)
+            await mb.close()
+            return futs
+
+        out = run(main())
+        assert out[3].__class__ is ValueError
+        assert [o for i, o in enumerate(out) if i != 3] == [0, 1, 2, 4, 5]
+
+    def test_batch_level_failure_rejects_all(self):
+        def batch_fn(queries):
+            raise RuntimeError("device gone")
+
+        async def main():
+            mb = MicroBatcher(batch_fn, window_s=0.001)
+            return await asyncio.gather(
+                *[mb.submit(i) for i in range(3)], return_exceptions=True)
+
+        out = run(main())
+        assert all(isinstance(o, RuntimeError) for o in out)
+
+    def test_stats(self):
+        def batch_fn(queries):
+            return [("ok", q) for q in queries]
+
+        async def main():
+            mb = MicroBatcher(batch_fn, window_s=0.005)
+            await asyncio.gather(*[mb.submit(i) for i in range(8)])
+            s = mb.stats()
+            await mb.close()
+            return s
+
+        s = run(main())
+        assert s["batchedQueries"] == 8
+        assert s["avgBatchSize"] >= 1.0
+
+
+class TestBatchedServing:
+    """serve_query_batch against the real recommendation template."""
+
+    @pytest.fixture
+    def served(self, rng, mesh8):
+        import sys
+        from pathlib import Path
+        import importlib.util
+
+        from predictionio_tpu.controller import EngineParams
+        from predictionio_tpu.storage import DataMap, Event, Storage
+        from predictionio_tpu.workflow import Context
+        from predictionio_tpu.workflow.create_server import EngineServer
+
+        repo = Path(__file__).resolve().parents[1]
+        spec = importlib.util.spec_from_file_location(
+            "tmpl_rec_mb", repo / "templates" / "recommendation" / "engine.py")
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["tmpl_rec_mb"] = mod
+        spec.loader.exec_module(mod)
+
+        meta = Storage.get_metadata()
+        app = meta.app_insert("MyApp")
+        ev = Storage.get_events()
+        ev.init_app(app.id)
+        for i in range(400):
+            u, it = rng.integers(0, 30), rng.integers(0, 20)
+            ev.insert(Event(
+                event="rate", entity_type="user", entity_id=f"u{u}",
+                target_entity_type="item", target_entity_id=f"i{it}",
+                properties=DataMap({"rating": float(rng.integers(1, 6))}),
+            ), app.id)
+        engine = mod.engine_factory()
+        ep = EngineParams(
+            data_source_params=("", mod.DataSourceParams(app_name="MyApp")),
+            algorithm_params_list=(
+                ("als", mod.AlgorithmParams(rank=4, num_iterations=5)),),
+        )
+        from predictionio_tpu.workflow import run_train
+        iid = run_train(engine, ep, Context(),
+                        engine_factory="tmpl_rec_mb:engine_factory")
+        inst = Storage.get_metadata().engine_instance_get(iid)
+        server = EngineServer(engine, inst, Context(mode="Serving"))
+        return server, mod
+
+    def test_batch_matches_single(self, served):
+        server, mod = served
+        queries = [{"user": f"u{i}", "num": 3} for i in range(8)]
+        batched = server.serve_query_batch(queries)
+        assert all(tag == "ok" for tag, _ in batched)
+        for qj, (_, got) in zip(queries, batched):
+            single = server.serve_query(qj)
+            # same ranking; scores may differ in float low bits (batched
+            # vs single matmul accumulation order)
+            assert [s["item"] for s in got["itemScores"]] == \
+                [s["item"] for s in single["itemScores"]]
+            np.testing.assert_allclose(
+                [s["score"] for s in got["itemScores"]],
+                [s["score"] for s in single["itemScores"]], rtol=1e-5)
+
+    def test_unknown_user_and_malformed_isolate(self, served):
+        server, _mod = served
+        out = server.serve_query_batch([
+            {"user": "u1", "num": 2},
+            {"user": "nobody", "num": 2},  # unknown -> empty scores, ok
+        ])
+        assert out[0][0] == "ok" and out[0][1]["itemScores"]
+        assert out[1][0] == "ok" and out[1][1]["itemScores"] == []
+
+    def test_negative_num_is_empty_not_crash(self, served):
+        server, _mod = served
+        out = server.serve_query_batch([{"user": "u1", "num": -1}])
+        assert out[0][0] == "ok" and out[0][1]["itemScores"] == []
+
+    def test_close_fails_pending(self):
+        import threading
+
+        started = threading.Event()
+
+        def slow_batch(queries):
+            started.wait(1)
+            return [("ok", q) for q in queries]
+
+        async def main():
+            mb = MicroBatcher(slow_batch, window_s=5.0)  # long window
+            t = asyncio.create_task(mb.submit(1))
+            await asyncio.sleep(0.01)  # lands in _pending, window open
+            await mb.close()
+            started.set()
+            return await asyncio.gather(t, return_exceptions=True)
+
+        (out,) = run(main())
+        assert isinstance(out, asyncio.CancelledError)
