@@ -1,0 +1,156 @@
+"""Stock-strategy toolkit: vectorized indicators + batched per-ticker ridge.
+
+Analog of the reference's stock backtest engine internals (reference:
+examples/experimental/scala-stock/src/main/scala/Indicators.scala —
+RSIIndicator/ShiftsIndicator over saddle Series; RegressionStrategy.scala —
+per-ticker nak LinearRegression on indicator features predicting next-day
+log return). The reference loops tickers and days through JVM series ops;
+here every indicator is one vectorized op over the whole [T, N] log-price
+matrix, and the per-ticker regressions are ONE batched normal-equation
+solve ([N, F, F] gramians built as MXU einsums; F is tiny, so the solve
+itself is negligible and runs as a plain batched jnp solve).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+__all__ = [
+    "ema", "feature_stack", "log_returns", "rsi", "shift_return",
+    "StockRegressionModel", "score_features", "train_stock_regression",
+    "predict_returns",
+]
+
+
+# ---------------------------------------------------------------------------
+# indicators — [T, N] in, [T, N] out (vectorized across tickers AND time)
+# ---------------------------------------------------------------------------
+
+def log_returns(log_price: np.ndarray, d: int = 1) -> np.ndarray:
+    """d-day log return; first d rows are 0 (ShiftsIndicator.getRet,
+    Indicators.scala:getRet fillNA semantics)."""
+    out = np.zeros_like(log_price)
+    out[d:] = log_price[d:] - log_price[:-d]
+    return out
+
+
+def shift_return(log_price: np.ndarray, period: int) -> np.ndarray:
+    """ShiftsIndicator: return over ``period`` days."""
+    return log_returns(log_price, period)
+
+
+def ema(x: np.ndarray, period: int) -> np.ndarray:
+    """Exponential moving average along time (axis 0)."""
+    alpha = 2.0 / (period + 1.0)
+    out = np.empty_like(x)
+    out[0] = x[0]
+    for t in range(1, len(x)):  # T is small (days); host loop is fine
+        out[t] = alpha * x[t] + (1 - alpha) * out[t - 1]
+    return out
+
+
+def rsi(log_price: np.ndarray, period: int = 14) -> np.ndarray:
+    """Relative Strength Index in [0, 100] (RSIIndicator,
+    Indicators.scala:59 — EMA-smoothed up/down moves of daily returns),
+    computed for all tickers at once; leading rows settle from 50."""
+    ret = log_returns(log_price, 1)
+    up = np.maximum(ret, 0.0)
+    dn = np.maximum(-ret, 0.0)
+    up_s = ema(up, period)
+    dn_s = ema(dn, period)
+    rs = up_s / (dn_s + 1e-12)
+    out = 100.0 - 100.0 / (1.0 + rs)
+    out[0] = 50.0
+    return out
+
+
+def feature_stack(log_price: np.ndarray, windows: tuple[int, ...],
+                  rsi_period: int) -> np.ndarray:
+    """[T, N, F]: per-day, per-ticker indicator vector (the reference's
+    calcIndicator output, RegressionStrategy.scala:calcIndicator)."""
+    feats = [shift_return(log_price, w) for w in windows]
+    feats.append(rsi(log_price, rsi_period) / 100.0 - 0.5)  # centered
+    return np.stack(feats, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# batched per-ticker regression
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class StockRegressionModel:
+    """Per-ticker linear weights over the indicator features + intercept."""
+
+    weights: np.ndarray  # [N, F+1]
+    windows: tuple
+    rsi_period: int
+
+
+def train_stock_regression(
+    log_price: np.ndarray,
+    *,
+    windows: tuple[int, ...] = (1, 5, 22),
+    rsi_period: int = 14,
+    l2: float = 1e-4,
+    train_end: int | None = None,
+) -> StockRegressionModel:
+    """Fit, per ticker, next-day return ~ indicators — all tickers in one
+    batched ridge solve (the reference regresses each ticker separately,
+    RegressionStrategy.scala:regress). ``train_end`` truncates the fit to
+    log_price[:train_end] — the walk-forward split that keeps backtested
+    days out of the fit (features beyond it stay causal, so scoring later
+    days is legitimate)."""
+    import jax
+    import jax.numpy as jnp
+
+    if train_end is not None:
+        log_price = log_price[:train_end]
+    t_all, n = log_price.shape
+    warm = max(max(windows), rsi_period) + 1
+    usable = t_all - warm - 1  # rows feeding the fit
+    if usable < 3:
+        raise ValueError(
+            f"need at least {warm + 4} days of prices for the fit "
+            f"({usable} usable rows after the {warm}-day indicator warm-up), "
+            f"have {t_all}")
+
+    x = feature_stack(log_price, windows, rsi_period)  # [T, N, F]
+    y = log_returns(log_price, 1)  # next-day return target, aligned below
+
+    # rows warm..T-2 predict the return at t+1
+    xs = x[warm:-1]  # [S, N, F]
+    ys = y[warm + 1:]  # [S, N]
+    f = xs.shape[-1]
+
+    @jax.jit
+    def fit(xs, ys):
+        xb = jnp.concatenate(
+            [xs, jnp.ones((*xs.shape[:2], 1), xs.dtype)], axis=-1)  # [S,N,F+1]
+        gram = jnp.einsum("snf,sng->nfg", xb, xb)  # [N, F+1, F+1]
+        rhs = jnp.einsum("snf,sn->nf", xb, ys)
+        reg = l2 * jnp.eye(f + 1, dtype=xs.dtype)[None] * xs.shape[0]
+        return jnp.linalg.solve(gram + reg, rhs[..., None]).squeeze(-1)
+
+    w = np.asarray(fit(jnp.asarray(xs, jnp.float32), jnp.asarray(ys, jnp.float32)))
+    return StockRegressionModel(weights=w, windows=tuple(windows),
+                                rsi_period=rsi_period)
+
+
+def score_features(model: StockRegressionModel, feats_row: np.ndarray) -> np.ndarray:
+    """Per-ticker predicted next-day return from one [N, F] feature row
+    (features are causal, so the row may come from a stack precomputed
+    over the full timeline once — no per-query recompute)."""
+    fb = np.concatenate(
+        [feats_row, np.ones((feats_row.shape[0], 1), feats_row.dtype)], axis=-1)
+    return np.einsum("nf,nf->n", fb, model.weights)
+
+
+def predict_returns(model: StockRegressionModel, log_price: np.ndarray,
+                    t_idx: int) -> np.ndarray:
+    """Predicted next-day return per ticker at day ``t_idx``. Convenience
+    wrapper recomputing the stack for the prefix; hot loops should
+    precompute ``feature_stack`` once and call ``score_features``."""
+    x = feature_stack(log_price[: t_idx + 1], model.windows, model.rsi_period)
+    return score_features(model, x[-1])

@@ -1,0 +1,285 @@
+"""Stock backtest engine — regression strategy + NAV backtesting evaluator.
+
+Analog of the reference's largest experimental engine (reference:
+examples/experimental/scala-stock/src/main/scala/{DataSource,Algorithm,
+RegressionStrategy,Indicators,BackTestingMetrics}.scala): daily close
+prices per ticker, indicator features (shifted returns, RSI), a
+per-ticker regression predicting next-day return, and a walk-forward
+backtest that enters/exits positions by threshold and reports
+NAV/return/volatility/sharpe (BackTestingMetrics.scala:20-180).
+
+Differences by design: prices arrive as ordinary events (the reference
+reads Yahoo-format rows via a custom PEvents scan, YahooDataSource.scala);
+indicators and the N per-ticker regressions are batched matrix ops
+(models/stock.py); the backtesting evaluator implements the legacy
+three-level Evaluator API (evaluate_unit/set/all) that the reference's
+``BacktestingEvaluator extends Evaluator`` uses.
+
+Events: {"event": "price", "entityType": "ticker", "entityId": "AAPL",
+         "properties": {"close": 187.3}, "eventTime": <trading day>}
+Query:  {"dateIdx": 37, "num": 3}        # rank tickers at day 37
+Result: {"tickerScores": [{"ticker": "AAPL", "score": 0.012}, ...]}
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    Evaluator,
+    FirstServing,
+    Params,
+    Preparator,
+    SanityCheck,
+)
+from predictionio_tpu.models.stock import (
+    feature_stack,
+    score_features,
+    train_stock_regression,
+)
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "MyApp"
+    price_event: str = "price"
+    #: evaluation: one fold, querying each day in [eval_start, end)
+    eval_start: int = 0
+
+
+@dataclass(frozen=True)
+class Query:
+    dateIdx: int = -1  # -1 = latest day
+    num: int = 5
+
+
+@dataclass(frozen=True)
+class TickerScore:
+    ticker: str = ""
+    score: float = 0.0
+
+
+@dataclass(frozen=True)
+class PredictedResult:
+    tickerScores: tuple = ()
+
+
+class PriceFrame(SanityCheck):
+    """[T, N] close prices + the time/ticker indexes (the reference's
+    saddle priceFrame, Data.scala). ``train_end`` (set by read_eval) is
+    the walk-forward split: the fit may only use days [0, train_end);
+    later days exist solely for causal feature computation + backtesting."""
+
+    def __init__(self, times: list, tickers: list[str], prices: np.ndarray,
+                 train_end: int | None = None):
+        self.times = times
+        self.tickers = tickers
+        self.prices = prices  # [T, N] f64, forward-filled
+        self.train_end = train_end
+
+    def sanity_check(self) -> None:
+        if self.prices.size == 0:
+            raise ValueError("no price events found")
+        if not np.isfinite(self.prices).all() or (self.prices <= 0).any():
+            raise ValueError("prices must be positive and finite "
+                             "(missing leading data for some ticker?)")
+
+
+class StockDataSource(DataSource):
+    """price events -> forward-filled [T, N] frame (YahooDataSource.scala's
+    merge/align path, minus the Yahoo wire format)."""
+
+    params_class = DataSourceParams
+
+    def _frame(self, ctx) -> PriceFrame:
+        store = ctx.event_store()
+        per_day: dict = defaultdict(dict)
+        for e in store.find(app_name=self.params.app_name,
+                            event_names=[self.params.price_event],
+                            latest=False):
+            try:
+                close = float(e.properties.get("close"))
+            except Exception as err:  # noqa: BLE001 — DataMapError/ValueError
+                raise ValueError(
+                    f"price event for {e.entity_id!r} at {e.event_time} has "
+                    f"no numeric 'close' property: {err}") from err
+            per_day[e.event_time][e.entity_id] = close
+        times = sorted(per_day)
+        tickers = sorted({t for d in per_day.values() for t in d})
+        prices = np.full((len(times), len(tickers)), np.nan)
+        col = {t: j for j, t in enumerate(tickers)}
+        for i, day in enumerate(times):
+            for t, p in per_day[day].items():
+                prices[i, col[t]] = p
+        # forward-fill gaps (reference aligns frames the same way)
+        for i in range(1, len(times)):
+            nanmask = np.isnan(prices[i])
+            prices[i, nanmask] = prices[i - 1, nanmask]
+        return PriceFrame(times, tickers, prices)
+
+    def read_training(self, ctx) -> PriceFrame:
+        return self._frame(ctx)
+
+    def read_eval(self, ctx):
+        frame = self._frame(ctx)
+        start = self.params.eval_start
+        frame.train_end = start  # walk-forward: fit sees only days < start
+        # num=0 = ALL tickers: the evaluator derives exits from the full
+        # score vector (a held position outside a top-k would otherwise
+        # never be exited)
+        qa = [(Query(dateIdx=i, num=0), None)
+              for i in range(start, len(frame.times) - 1)]
+        return [(frame, {"frame": frame}, qa)]
+
+
+class StockPreparator(Preparator):
+    def prepare(self, ctx, td: PriceFrame):
+        td.log_prices = np.log(td.prices)
+        return td
+
+
+@dataclass(frozen=True)
+class StrategyParams(Params):
+    """(RegressionStrategyParams, RegressionStrategy.scala:27-30)"""
+
+    windows: tuple = (1, 5, 22)
+    rsi_period: int = 14
+    l2: float = 1e-4
+
+
+class RegressionStrategyAlgorithm(Algorithm):
+    params_class = StrategyParams
+    query_class = Query
+
+    def train(self, ctx, pd: PriceFrame):
+        model = train_stock_regression(
+            pd.log_prices, windows=tuple(self.params.windows),
+            rsi_period=self.params.rsi_period, l2=self.params.l2,
+            train_end=pd.train_end,
+        )
+        # indicators are causal, so the stack precomputed ONCE over the
+        # full timeline serves every query day (no per-query recompute)
+        feats = feature_stack(pd.log_prices, model.windows, model.rsi_period)
+        return model, pd, feats
+
+    def predict(self, model_and_frame, query: Query) -> PredictedResult:
+        model, frame, feats = model_and_frame
+        t = query.dateIdx if query.dateIdx >= 0 else len(frame.times) - 1
+        if not (0 <= t < len(frame.times)):
+            return PredictedResult()
+        scores = score_features(model, feats[t])
+        order = np.argsort(-scores)
+        n = len(order) if query.num <= 0 else min(query.num, len(order))
+        return PredictedResult(tickerScores=tuple(
+            TickerScore(ticker=frame.tickers[int(j)], score=float(scores[j]))
+            for j in order[:n]
+        ))
+
+
+# ---------------------------------------------------------------------------
+# backtesting (legacy Evaluator API, BackTestingMetrics.scala)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class BacktestingParams(Params):
+    """(BacktestingParams, BackTestingMetrics.scala:20-25)"""
+
+    enter_threshold: float = 0.001
+    exit_threshold: float = 0.0
+    max_positions: int = 1
+
+
+@dataclass
+class DailyStat:
+    dateIdx: int
+    nav: float
+    ret: float
+    position_count: int
+
+
+@dataclass
+class BacktestingResult:
+    daily: list = field(default_factory=list)
+    ret: float = 0.0  # overall return
+    vol: float = 0.0  # daily-return volatility
+    sharpe: float = 0.0
+    days: int = 0
+
+    def to_one_liner(self) -> str:
+        return (f"ret={self.ret:.4f} vol={self.vol:.5f} "
+                f"sharpe={self.sharpe:.3f} days={self.days}")
+
+
+class BacktestingEvaluator(Evaluator):
+    """evaluate_unit -> daily enter/exit by threshold; evaluate_all walks
+    the NAV with at most ``max_positions`` equal-weight positions
+    (BackTestingMetrics.scala:70-180)."""
+
+    def __init__(self, params: BacktestingParams | None = None):
+        self.params = params or BacktestingParams()
+
+    def evaluate_unit(self, query, prediction, actual):
+        p = self.params
+        to_enter = [s.ticker for s in prediction.tickerScores
+                    if s.score >= p.enter_threshold]
+        to_exit = [s.ticker for s in prediction.tickerScores
+                   if s.score <= p.exit_threshold]
+        return (query.dateIdx, to_enter, to_exit)
+
+    def evaluate_set(self, eval_info, units):
+        return sorted(units, key=lambda u: u[0])
+
+    def evaluate_all(self, sets):
+        frame: PriceFrame = sets[0][0]["frame"]
+        prices = frame.prices
+        p = self.params
+        init_cash = 1_000_000.0
+        cash = init_cash
+        positions: dict[str, float] = {}  # ticker -> shares
+        col = {t: j for j, t in enumerate(frame.tickers)}
+        daily: list[DailyStat] = []
+        prev_nav = init_cash
+        rets = []
+        for _info, units in sets:
+            for date_idx, to_enter, to_exit in units:
+                row = prices[date_idx]
+                for t in to_exit:
+                    if t in positions:
+                        cash += positions.pop(t) * row[col[t]]
+                for t in to_enter:
+                    if t not in positions and len(positions) < p.max_positions:
+                        alloc = cash / (p.max_positions - len(positions))
+                        positions[t] = alloc / row[col[t]]
+                        cash -= alloc
+                nav = cash + sum(sh * row[col[t]] for t, sh in positions.items())
+                ret = nav / prev_nav - 1.0
+                rets.append(ret)
+                daily.append(DailyStat(date_idx, nav, ret, len(positions)))
+                prev_nav = nav
+        if not daily:
+            return BacktestingResult()
+        rets_a = np.asarray(rets)
+        vol = float(rets_a.std())
+        mean = float(rets_a.mean())
+        return BacktestingResult(
+            daily=daily,
+            ret=daily[-1].nav / init_cash - 1.0,
+            vol=vol,
+            sharpe=(mean / vol * np.sqrt(252)) if vol > 0 else 0.0,
+            days=len(daily),
+        )
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_classes=StockDataSource,
+        preparator_classes=StockPreparator,
+        algorithm_classes={"regression": RegressionStrategyAlgorithm},
+        serving_classes=FirstServing,
+    )

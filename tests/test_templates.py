@@ -315,3 +315,58 @@ class TestMarkovChain:
         assert out.itemScores[0].score == pytest.approx(1.0)
         # unseen item -> empty result
         assert algo.predict(model, mod.Query(item="zzz")).itemScores == ()
+
+
+class TestStock:
+    def _ingest_prices(self, app, t_days=80):
+        from datetime import datetime, timedelta, timezone
+
+        t0 = datetime(2026, 1, 1, tzinfo=timezone.utc)
+        rng = np.random.default_rng(7)
+        # UP trends steadily; DOWN decays; FLAT is noise
+        paths = {
+            "UP": 100 * np.exp(np.cumsum(0.01 + 0.001 * rng.standard_normal(t_days))),
+            "DOWN": 100 * np.exp(np.cumsum(-0.01 + 0.001 * rng.standard_normal(t_days))),
+            "FLAT": 100 * np.exp(np.cumsum(0.0005 * rng.standard_normal(t_days))),
+        }
+        for t in range(t_days):
+            for tick, path in paths.items():
+                insert(app.id, event="price", entity_type="ticker",
+                       entity_id=tick, props={"close": float(path[t])},
+                       event_time=t0 + timedelta(days=t))
+
+    def test_strategy_ranks_momentum(self, mesh8):
+        mod = load_template("stock")
+        app = setup_app()
+        self._ingest_prices(app)
+        engine = mod.engine_factory()
+        ep = EngineParams(
+            data_source_params=("", mod.DataSourceParams(app_name="MyApp")),
+            algorithm_params_list=(("regression", mod.StrategyParams()),),
+        )
+        result = engine.train(Context(), ep)
+        algo, model = result.algorithms[0], result.models[0]
+        out = algo.predict(model, mod.Query(dateIdx=-1, num=3))
+        assert out.tickerScores[0].ticker == "UP"
+        assert out.tickerScores[-1].ticker == "DOWN"
+        assert out.tickerScores[0].score > out.tickerScores[-1].score
+
+    def test_backtest_profits_on_trend(self, mesh8):
+        mod = load_template("stock")
+        app = setup_app()
+        self._ingest_prices(app)
+        engine = mod.engine_factory()
+        ep = EngineParams(
+            data_source_params=(
+                "", mod.DataSourceParams(app_name="MyApp", eval_start=40)),
+            algorithm_params_list=(("regression", mod.StrategyParams()),),
+        )
+        folds = engine.eval(Context(), ep)
+        assert len(folds) == 1
+        evaluator = mod.BacktestingEvaluator(mod.BacktestingParams(
+            enter_threshold=0.002, exit_threshold=-0.002, max_positions=1))
+        res = evaluator.evaluate(folds)
+        assert res.days > 0
+        # riding the UP trend must beat cash
+        assert res.ret > 0
+        assert "sharpe=" in res.to_one_liner()
