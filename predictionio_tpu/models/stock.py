@@ -18,7 +18,7 @@ import dataclasses
 import numpy as np
 
 __all__ = [
-    "ema", "feature_stack", "log_returns", "rsi", "shift_return",
+    "ema", "feature_stack", "log_returns", "rsi",
     "StockRegressionModel", "score_features", "train_stock_regression",
     "predict_returns",
 ]
@@ -34,11 +34,6 @@ def log_returns(log_price: np.ndarray, d: int = 1) -> np.ndarray:
     out = np.zeros_like(log_price)
     out[d:] = log_price[d:] - log_price[:-d]
     return out
-
-
-def shift_return(log_price: np.ndarray, period: int) -> np.ndarray:
-    """ShiftsIndicator: return over ``period`` days."""
-    return log_returns(log_price, period)
 
 
 def ema(x: np.ndarray, period: int) -> np.ndarray:
@@ -70,7 +65,8 @@ def feature_stack(log_price: np.ndarray, windows: tuple[int, ...],
                   rsi_period: int) -> np.ndarray:
     """[T, N, F]: per-day, per-ticker indicator vector (the reference's
     calcIndicator output, RegressionStrategy.scala:calcIndicator)."""
-    feats = [shift_return(log_price, w) for w in windows]
+    # ShiftsIndicator analog: returns over each window
+    feats = [log_returns(log_price, w) for w in windows]
     feats.append(rsi(log_price, rsi_period) / 100.0 - 0.5)  # centered
     return np.stack(feats, axis=-1)
 
@@ -130,7 +126,9 @@ def train_stock_regression(
             [xs, jnp.ones((*xs.shape[:2], 1), xs.dtype)], axis=-1)  # [S,N,F+1]
         gram = jnp.einsum("snf,sng->nfg", xb, xb)  # [N, F+1, F+1]
         rhs = jnp.einsum("snf,sn->nf", xb, ys)
-        reg = l2 * jnp.eye(f + 1, dtype=xs.dtype)[None] * xs.shape[0]
+        # intercept column unregularized (same convention as
+        # models/linreg.py — shrinking it would bias drift tickers to 0)
+        reg = (l2 * jnp.eye(f + 1, dtype=xs.dtype).at[f, f].set(0.0))[None] * xs.shape[0]
         return jnp.linalg.solve(gram + reg, rhs[..., None]).squeeze(-1)
 
     w = np.asarray(fit(jnp.asarray(xs, jnp.float32), jnp.asarray(ys, jnp.float32)))

@@ -109,7 +109,9 @@ class StockDataSource(DataSource):
                 raise ValueError(
                     f"price event for {e.entity_id!r} at {e.event_time} has "
                     f"no numeric 'close' property: {err}") from err
-            per_day[e.event_time][e.entity_id] = close
+            # group by calendar day: intraday timestamp jitter between
+            # tickers must not fragment one trading day into many rows
+            per_day[e.event_time.date()][e.entity_id] = close
         times = sorted(per_day)
         tickers = sorted({t for d in per_day.values() for t in d})
         prices = np.full((len(times), len(tickers)), np.nan)
@@ -128,6 +130,9 @@ class StockDataSource(DataSource):
 
     def read_eval(self, ctx):
         frame = self._frame(ctx)
+        # the train path sanity-checks via the engine; eval must too, or
+        # NaN prices silently backtest as a zero-trade "strategy"
+        frame.sanity_check()
         start = self.params.eval_start
         frame.train_end = start  # walk-forward: fit sees only days < start
         # num=0 = ALL tickers: the evaluator derives exits from the full
