@@ -1,0 +1,87 @@
+"""HelloWorld engine — the smallest possible DASE engine.
+
+Analog of the reference's hello-world tutorial engines (reference:
+examples/experimental/scala-local-helloworld/HelloWorld.scala,
+java-local-helloworld/): temperature readings per weekday, the "model"
+is the per-day average, and a query for a day returns it. Readings
+arrive as ordinary events instead of a CSV file:
+
+Events: {"event": "read", "entityType": "sensor", "entityId": "s1",
+         "properties": {"day": "Mon", "temperature": 75.5}}
+Query:  {"day": "Mon"}
+Result: {"temperature": 75.8}
+
+This is the template to copy when writing a new engine: one DataSource,
+the identity Preparator, one Algorithm, first-prediction Serving.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from dataclasses import dataclass
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    IdentityPreparator,
+    Params,
+)
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "MyApp"
+    read_event: str = "read"
+
+
+@dataclass(frozen=True)
+class Query:
+    day: str = ""
+
+
+@dataclass(frozen=True)
+class PredictedResult:
+    temperature: float = 0.0
+
+
+class HelloDataSource(DataSource):
+    params_class = DataSourceParams
+
+    def read_training(self, ctx) -> list[tuple[str, float]]:
+        store = ctx.event_store()
+        out = []
+        for e in store.find(app_name=self.params.app_name,
+                            event_names=[self.params.read_event]):
+            try:
+                out.append((str(e.properties.get("day")),
+                            float(e.properties.get("temperature"))))
+            except Exception as err:  # noqa: BLE001 — name the bad event
+                raise ValueError(
+                    f"read event for {e.entity_id!r} at {e.event_time} needs "
+                    f"'day' and numeric 'temperature' properties: {err}"
+                ) from err
+        return out
+
+
+class AverageAlgorithm(Algorithm):
+    query_class = Query
+
+    def train(self, ctx, pd: list[tuple[str, float]]) -> dict[str, float]:
+        sums: dict[str, list[float]] = defaultdict(list)
+        for day, temp in pd:
+            sums[day].append(temp)
+        return {day: sum(v) / len(v) for day, v in sums.items()}
+
+    def predict(self, model: dict[str, float], query: Query) -> PredictedResult:
+        return PredictedResult(temperature=model.get(query.day, 0.0))
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_classes=HelloDataSource,
+        preparator_classes=IdentityPreparator,
+        algorithm_classes={"average": AverageAlgorithm},
+        serving_classes=FirstServing,
+    )

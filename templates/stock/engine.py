@@ -25,6 +25,7 @@ from __future__ import annotations
 
 from collections import defaultdict
 from dataclasses import dataclass, field
+from datetime import timezone
 
 import numpy as np
 
@@ -109,9 +110,11 @@ class StockDataSource(DataSource):
                 raise ValueError(
                     f"price event for {e.entity_id!r} at {e.event_time} has "
                     f"no numeric 'close' property: {err}") from err
-            # group by calendar day: intraday timestamp jitter between
-            # tickers must not fragment one trading day into many rows
-            per_day[e.event_time.date()][e.entity_id] = close
+            # group by UTC calendar day: intraday timestamp jitter between
+            # tickers must not fragment one trading day into many rows, and
+            # the bucket must not depend on the client's tz offset
+            day = e.event_time.astimezone(timezone.utc).date()
+            per_day[day][e.entity_id] = close
         times = sorted(per_day)
         tickers = sorted({t for d in per_day.values() for t in d})
         prices = np.full((len(times), len(tickers)), np.nan)

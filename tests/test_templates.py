@@ -370,3 +370,22 @@ class TestStock:
         # riding the UP trend must beat cash
         assert res.ret > 0
         assert "sharpe=" in res.to_one_liner()
+
+
+class TestHelloWorld:
+    def test_average_per_day(self, mesh8):
+        mod = load_template("helloworld")
+        app = setup_app()
+        for day, temp in [("Mon", 70.0), ("Mon", 80.0), ("Tue", 60.0)]:
+            insert(app.id, event="read", entity_type="sensor", entity_id="s1",
+                   props={"day": day, "temperature": temp})
+        engine = mod.engine_factory()
+        ep = EngineParams(
+            data_source_params=("", mod.DataSourceParams(app_name="MyApp")),
+            algorithm_params_list=(("average", None),),
+        )
+        result = engine.train(Context(), ep)
+        algo, model = result.algorithms[0], result.models[0]
+        assert algo.predict(model, mod.Query(day="Mon")).temperature == 75.0
+        assert algo.predict(model, mod.Query(day="Tue")).temperature == 60.0
+        assert algo.predict(model, mod.Query(day="Sun")).temperature == 0.0
