@@ -18,8 +18,12 @@ import numpy as np
 
 from predictionio_tpu.controller import (
     Algorithm,
+    AverageMetric,
     DataSource,
     Engine,
+    EngineParams,
+    EngineParamsGenerator,
+    Evaluation,
     FirstServing,
     Params,
     Preparator,
@@ -173,6 +177,52 @@ class ALSAlgorithm(Algorithm):
                 ItemScore(item=t, score=s) for t, s in rec)))
             for (i, _q), rec in zip(queries, recs)
         ]
+
+
+# ---------------------------------------------------------------------------
+# evaluation + tuning (`pio eval` entry points; reference: the templates'
+# Evaluation.scala companions + EngineParamsGenerator, SURVEY §3.3)
+# ---------------------------------------------------------------------------
+
+class HitRateAtK(AverageMetric):
+    """Fraction of held-out (user, item) pairs recovered in the top-num
+    recommendations — leave-one-out hit rate (NOT precision@K, which
+    would divide each hit by K)."""
+
+    def calculate_qpa(self, q, p, a) -> float:
+        return 1.0 if any(s.item == a["item"] for s in p.itemScores) else 0.0
+
+    def header(self) -> str:
+        return "HitRate@K"
+
+
+class RecommendationEvaluation(Evaluation):
+    """`pio eval --engine-dir templates/recommendation engine:RecommendationEvaluation`
+    k-fold hit-rate@k over a small rank/lambda grid."""
+
+    def __init__(self, app_name: str = "MyApp", eval_k: int = 3):
+        self.engine = engine_factory()
+        self.metric = HitRateAtK()
+        ds = DataSourceParams(app_name=app_name, eval_k=eval_k,
+                              eval_queries_per_user=10)
+        self.engine_params_list = [
+            EngineParams(
+                data_source_params=("", ds),
+                algorithm_params_list=(
+                    ("als", AlgorithmParams(rank=rank, num_iterations=10,
+                                            lambda_=lam)),
+                ),
+            )
+            for rank in (5, 10)
+            for lam in (0.01, 0.1)
+        ]
+
+
+class ParamsGrid(EngineParamsGenerator):
+    """Standalone generator (`--engine-params-generator engine:ParamsGrid`)."""
+
+    def __init__(self):
+        self.engine_params_list = RecommendationEvaluation().engine_params_list
 
 
 def engine_factory() -> Engine:
