@@ -21,7 +21,7 @@ from .event import (
 from .events_base import ANY, EventBackend, EventQuery, StorageError
 from .frame import EventFrame, Ratings
 from .memory import MemoryEvents
-from .partition import entity_key, hash64, partition_events, shard_of
+from .partition import entity_key, hash64, iter_host_shard, partition_events, shard_of
 from .metadata import (
     AccessKey,
     App,
@@ -43,6 +43,6 @@ __all__ = [
     "SQLiteEvents", "Storage", "StorageError", "ValidationError",
     "aggregate_properties", "aggregate_properties_single",
     "event_from_api_dict", "event_from_json", "event_to_api_dict",
-    "entity_key", "hash64", "partition_events", "shard_of",
+    "entity_key", "hash64", "iter_host_shard", "partition_events", "shard_of",
     "event_to_json", "string_int_bimap", "validate_event",
 ]

@@ -20,7 +20,7 @@ import numpy as np
 from .. import native
 from .event import Event
 
-__all__ = ["entity_key", "hash64", "shard_of", "partition_events"]
+__all__ = ["entity_key", "hash64", "iter_host_shard", "partition_events", "shard_of"]
 
 _M = 0xFFFFFFFFFFFFFFFF
 
@@ -49,6 +49,32 @@ def hash64(keys: Sequence[bytes] | Sequence[str], seed: int = 0) -> np.ndarray:
 
 def shard_of(entity_type: str, entity_id: str, num_shards: int, seed: int = 0) -> int:
     return int(hash64([entity_key(entity_type, entity_id)], seed)[0] % num_shards)
+
+
+def iter_host_shard(
+    events: Iterable[Event], index: int, count: int, seed: int = 0,
+    _chunk: int = 8192,
+) -> Iterable[Event]:
+    """Stream only the events whose entity hashes to shard ``index`` of
+    ``count`` — chunked so the native batch hash does the work while peak
+    memory stays one chunk, not the full stream."""
+    if count < 1 or not (0 <= index < count):
+        raise ValueError(f"invalid shard ({index}, {count})")
+    buf: list[Event] = []
+
+    def flush():
+        hs = hash64([entity_key(e.entity_type, e.entity_id) for e in buf], seed)
+        for e, h in zip(buf, hs):
+            if int(h % np.uint64(count)) == index:
+                yield e
+
+    for e in events:
+        buf.append(e)
+        if len(buf) >= _chunk:
+            yield from flush()
+            buf = []
+    if buf:
+        yield from flush()
 
 
 def partition_events(

@@ -15,12 +15,24 @@ from __future__ import annotations
 from datetime import datetime
 from typing import Any, Iterator, Sequence
 
+import numpy as np
+
 from ..storage import EventQuery, PropertyMap, Storage
 from ..storage.event import Event
 from ..storage.events_base import ANY, StorageError
 from ..storage.frame import EventFrame
 
 __all__ = ["EventStore", "app_name_to_id"]
+
+
+def _validate_host_shard(index: int, count: int) -> None:
+    # validate BEFORE any single-host shortcut: a misconfigured launch
+    # (e.g. (3, 1)) must fail loudly, not silently ingest the full stream
+    # on several processes at once
+    if count < 1 or not (0 <= index < count):
+        raise ValueError(
+            f"host_shard ({index}, {count}) invalid: need count >= 1 and "
+            f"0 <= index < count")
 
 
 def app_name_to_id(app_name: str, channel_name: str | None = None) -> tuple[int, int | None]:
@@ -64,22 +76,43 @@ class EventStore:
         event_names: Sequence[str] | None = None,
         target_entity_type: Any = ANY,
         target_entity_id: Any = ANY,
+        host_shard: tuple[int, int] | None = None,
     ) -> EventFrame:
-        """Columnar scan for training (PEventStore.find analog)."""
+        """Columnar scan for training (PEventStore.find analog).
+
+        ``host_shard=(index, count)`` keeps only the entities hashing to
+        this host's shard — the multi-host data-loading contract: each
+        process of a ``jax.distributed`` job passes
+        ``(process_index, process_count)`` and ingests a disjoint slice of
+        the event stream with every entity's full history on one host
+        (deterministic splitmix64 entity hash, the HBase row-key-prefix
+        analog — storage/partition.py). Pass None on single-host.
+        """
         app_id, channel_id = self._resolve(app_name, channel_name)
-        return Storage.get_events().find_frame(
-            EventQuery(
-                app_id=app_id,
-                channel_id=channel_id,
-                start_time=start_time,
-                until_time=until_time,
-                entity_type=entity_type,
-                entity_id=entity_id,
-                event_names=tuple(event_names) if event_names else None,
-                target_entity_type=target_entity_type,
-                target_entity_id=target_entity_id,
-            )
+        query = EventQuery(
+            app_id=app_id,
+            channel_id=channel_id,
+            start_time=start_time,
+            until_time=until_time,
+            entity_type=entity_type,
+            entity_id=entity_id,
+            event_names=tuple(event_names) if event_names else None,
+            target_entity_type=target_entity_type,
+            target_entity_id=target_entity_id,
         )
+        if host_shard is not None:
+            index, count = host_shard
+            _validate_host_shard(index, count)
+            if count > 1:
+                # stream-filter BEFORE materializing columns: per-host
+                # peak memory is this host's slice (+ one hash chunk),
+                # not the full dataset
+                from ..storage.partition import iter_host_shard
+
+                events = Storage.get_events().find(query)
+                return EventFrame.from_events(
+                    iter_host_shard(events, index, count))
+        return Storage.get_events().find_frame(query)
 
     def aggregate_properties(
         self,

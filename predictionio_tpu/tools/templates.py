@@ -24,6 +24,12 @@ _DESCRIPTIONS = {
     "classification": "NaiveBayes / logistic-regression classifier (scala-parallel-classification)",
     "ecommercerecommendation": "ALS + real-time availability filters (scala-parallel-ecommercerecommendation)",
     "twotower": "two-tower neural retrieval (JAX user/item encoders)",
+    "seqrec": "SASRec-style sequential recommender (ring/Ulysses attention)",
+    "regression": "ridge regression on event properties (scala-local-regression)",
+    "friendrecommendation": "keyword-similarity matching (scala-local-friend-recommendation)",
+    "markovchain": "next-item Markov chain (e2 MarkovChain)",
+    "stock": "stock backtest: indicators + regression strategy (scala-stock)",
+    "helloworld": "minimal copy-me engine (per-day averages)",
 }
 
 

@@ -37,7 +37,8 @@ from predictionio_tpu.storage.frame import Ratings
 class DataSourceParams(Params):
     app_name: str = "MyApp"
     eval_k: int = 0  # folds for `pio eval` (0 = none)
-    eval_queries_per_user: int = 1
+    #: top-k depth of each eval query (the K of HitRateAtK)
+    eval_top_k: int = 1
 
 
 @dataclass(frozen=True)
@@ -131,7 +132,7 @@ class RecommendationDataSource(DataSource):
                 u = inv_users[int(full.user_indices[i])]
                 it = inv_items[int(full.item_indices[i])]
                 qa.append(
-                    (Query(user=u, num=self.params.eval_queries_per_user),
+                    (Query(user=u, num=self.params.eval_top_k),
                      {"item": it, "rating": float(full.ratings[i])})
                 )
             folds.append((TrainingData(train), {"fold": fold}, qa))
@@ -185,15 +186,37 @@ class ALSAlgorithm(Algorithm):
 # ---------------------------------------------------------------------------
 
 class HitRateAtK(AverageMetric):
-    """Fraction of held-out (user, item) pairs recovered in the top-num
+    """Fraction of held-out (user, item) pairs recovered in the top-k
     recommendations — leave-one-out hit rate (NOT precision@K, which
     would divide each hit by K)."""
+
+    def __init__(self, k: int):
+        self.k = k
 
     def calculate_qpa(self, q, p, a) -> float:
         return 1.0 if any(s.item == a["item"] for s in p.itemScores) else 0.0
 
     def header(self) -> str:
-        return "HitRate@K"
+        return f"HitRate@{self.k}"
+
+
+_EVAL_TOP_K = 10
+
+
+def _params_grid(app_name: str = "MyApp", eval_k: int = 3) -> list[EngineParams]:
+    ds = DataSourceParams(app_name=app_name, eval_k=eval_k,
+                          eval_top_k=_EVAL_TOP_K)
+    return [
+        EngineParams(
+            data_source_params=("", ds),
+            algorithm_params_list=(
+                ("als", AlgorithmParams(rank=rank, num_iterations=10,
+                                        lambda_=lam)),
+            ),
+        )
+        for rank in (5, 10)
+        for lam in (0.01, 0.1)
+    ]
 
 
 class RecommendationEvaluation(Evaluation):
@@ -202,27 +225,15 @@ class RecommendationEvaluation(Evaluation):
 
     def __init__(self, app_name: str = "MyApp", eval_k: int = 3):
         self.engine = engine_factory()
-        self.metric = HitRateAtK()
-        ds = DataSourceParams(app_name=app_name, eval_k=eval_k,
-                              eval_queries_per_user=10)
-        self.engine_params_list = [
-            EngineParams(
-                data_source_params=("", ds),
-                algorithm_params_list=(
-                    ("als", AlgorithmParams(rank=rank, num_iterations=10,
-                                            lambda_=lam)),
-                ),
-            )
-            for rank in (5, 10)
-            for lam in (0.01, 0.1)
-        ]
+        self.metric = HitRateAtK(_EVAL_TOP_K)
+        self.engine_params_list = _params_grid(app_name, eval_k)
 
 
 class ParamsGrid(EngineParamsGenerator):
     """Standalone generator (`--engine-params-generator engine:ParamsGrid`)."""
 
     def __init__(self):
-        self.engine_params_list = RecommendationEvaluation().engine_params_list
+        self.engine_params_list = _params_grid()
 
 
 def engine_factory() -> Engine:

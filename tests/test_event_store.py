@@ -148,3 +148,52 @@ def test_find_frame_columnar(backend):
     ratings = frame.to_ratings()
     assert len(ratings) == 2
     assert ratings.num_users == 2 and ratings.num_items == 2
+
+
+class TestHostSharding:
+    """Multi-host data loading: disjoint, exhaustive, entity-coherent
+    shards (the HBase row-key-prefix partitioning analog)."""
+
+    def _setup(self):
+        from predictionio_tpu.storage import DataMap, Event, Storage
+
+        meta = Storage.get_metadata()
+        app = meta.app_insert("ShardApp")
+        ev = Storage.get_events()
+        ev.init_app(app.id)
+        for i in range(200):
+            ev.insert(Event(
+                event="rate", entity_type="user", entity_id=f"u{i % 40}",
+                target_entity_type="item", target_entity_id=f"i{i % 11}",
+                properties=DataMap({"rating": float(i % 5 + 1)}),
+            ), app.id)
+        return app
+
+    def test_shards_partition_the_stream(self):
+        from predictionio_tpu.store.event_store import EventStore
+
+        self._setup()
+        store = EventStore()
+        full = store.find_frame("ShardApp")
+        parts = [store.find_frame("ShardApp", host_shard=(i, 4)) for i in range(4)]
+        assert sum(len(p) for p in parts) == len(full) == 200
+        # entity-coherent: each user's full history lands on exactly one host
+        seen: dict[str, int] = {}
+        for hi, p in enumerate(parts):
+            for uid in set(p.entity_id.tolist()):
+                assert seen.setdefault(uid, hi) == hi
+
+    def test_single_host_passthrough_and_bad_index(self):
+        import pytest as _pytest
+        from predictionio_tpu.store.event_store import EventStore
+
+        self._setup()
+        store = EventStore()
+        assert len(store.find_frame("ShardApp", host_shard=(0, 1))) == 200
+        with _pytest.raises(ValueError):
+            store.find_frame("ShardApp", host_shard=(5, 4))
+        # invalid tuples must fail loudly even when count <= 1
+        with _pytest.raises(ValueError):
+            store.find_frame("ShardApp", host_shard=(3, 1))
+        with _pytest.raises(ValueError):
+            store.find_frame("ShardApp", host_shard=(0, 0))
