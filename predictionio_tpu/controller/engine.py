@@ -122,15 +122,19 @@ class Engine(Generic[TD, EI, PD, Q, P, A]):
         stop_after_read = bool(getattr(wp, "stop_after_read", False))
         stop_after_prepare = bool(getattr(wp, "stop_after_prepare", False))
 
+        from ..workflow.tracing import phase_timer
+
         data_source = self.make_data_source(engine_params)
-        td = data_source.read_training(ctx)
+        with phase_timer(ctx, "datasource.read_training"):
+            td = data_source.read_training(ctx)
         _maybe_sanity_check(td, skip_sanity, "TrainingData")
         if stop_after_read:
             log.info("Stopping here because --stop-after-read is set.")
             raise StopAfterReadInterruption()
 
         preparator = self.make_preparator(engine_params)
-        pd = preparator.prepare(ctx, td)
+        with phase_timer(ctx, "preparator.prepare"):
+            pd = preparator.prepare(ctx, td)
         _maybe_sanity_check(pd, skip_sanity, "PreparedData")
         if stop_after_prepare:
             log.info("Stopping here because --stop-after-prepare is set.")
@@ -144,7 +148,8 @@ class Engine(Generic[TD, EI, PD, Q, P, A]):
             # two entries of the same algorithm class must not collide
             ctx.current_algorithm = f"{name or type(algo).__name__}#{i}"
             try:
-                m = algo.train(ctx, pd)
+                with phase_timer(ctx, f"train[{ctx.current_algorithm}]"):
+                    m = algo.train(ctx, pd)
             finally:
                 ctx.current_algorithm = None
             _maybe_sanity_check(m, skip_sanity, f"Model of {type(algo).__name__}")

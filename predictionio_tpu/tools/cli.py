@@ -252,6 +252,7 @@ def cmd_train(args) -> int:
         mesh_axes=("data", "model") if args.mesh else None,
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
+        profile_dir=args.profile_dir,
     )
     iid = run_train(
         engine,
@@ -485,6 +486,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--checkpoint-every", type=int, default=5,
                     help="checkpoint every N training iterations "
                          "(with --checkpoint-dir)")
+    sp.add_argument("--profile-dir", default=None,
+                    help="capture a jax.profiler trace of training into "
+                         "this directory (view with TensorBoard/XProf)")
 
     sp = sub.add_parser("eval")
     _add_engine_args(sp)

@@ -53,6 +53,7 @@ class Context:
         extra: Mapping[str, Any] | None = None,
         checkpoint_dir: str | None = None,
         checkpoint_every: int = 0,
+        profile_dir: str | None = None,
     ):
         self.mode = mode
         self.batch = batch
@@ -65,6 +66,8 @@ class Context:
         #: algorithms that support step-level resume read these
         self.checkpoint_dir = checkpoint_dir
         self.checkpoint_every = checkpoint_every
+        #: jax.profiler trace output dir for this run (workflow/tracing.py)
+        self.profile_dir = profile_dir
         #: set by Engine.train around each algorithm's train() call —
         #: namespaces per-algorithm state such as checkpoints
         self.current_algorithm: str | None = None

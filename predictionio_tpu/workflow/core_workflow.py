@@ -151,7 +151,11 @@ def run_train(
     instance = dataclasses.replace(instance, id=instance_id)
     log.info("EngineInstance %s created; training starts", instance_id)
     try:
-        result = engine.train(ctx, engine_params)
+        from .tracing import maybe_profile, phase_report
+
+        with maybe_profile(getattr(ctx, "profile_dir", None)):
+            result = engine.train(ctx, engine_params)
+        log.info("training phases: %s", phase_report(ctx))
         models = _persistable(result, instance_id)
         blob = serialize_models(models)
         Storage.get_models().insert(Model(id=instance_id, models=blob))

@@ -1,0 +1,61 @@
+"""Structured per-phase timing + jax.profiler trace capture.
+
+The reference has no tracing beyond logging — Spark's UI is its implicit
+profiler (SURVEY.md §5). The TPU build surfaces the equivalents natively:
+
+- ``phase_timer``: wall-clock per pipeline phase (read/prepare/train-algo),
+  logged structured and accumulated on the Context so `pio train -v`
+  prints a phase breakdown at the end — the role of Spark's stage view.
+- ``maybe_profile``: wraps a region in ``jax.profiler.trace`` when a
+  trace directory is set (``pio train --profile-dir``); the output loads
+  in TensorBoard/XProf (device timelines, HLO cost analysis).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+
+log = logging.getLogger("predictionio_tpu.workflow")
+
+__all__ = ["maybe_profile", "phase_timer", "phase_report"]
+
+
+@contextlib.contextmanager
+def phase_timer(ctx, phase: str):
+    """Time one pipeline phase; record on ctx.phase_times + log."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        dt = time.perf_counter() - t0
+        times = getattr(ctx, "phase_times", None)
+        if times is None:
+            times = ctx.phase_times = []
+        times.append((phase, dt))
+        log.info("phase %-24s %8.3fs", phase, dt)
+
+
+def phase_report(ctx) -> str:
+    """One-line breakdown of every timed phase, longest first."""
+    times = getattr(ctx, "phase_times", None) or []
+    total = sum(dt for _, dt in times)
+    parts = ", ".join(
+        f"{p}={dt:.2f}s" for p, dt in sorted(times, key=lambda x: -x[1]))
+    return f"total {total:.2f}s ({parts})" if parts else "no phases timed"
+
+
+@contextlib.contextmanager
+def maybe_profile(trace_dir: str | None):
+    """jax.profiler.trace when a directory is given; no-op otherwise."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    log.info("capturing jax profiler trace -> %s", trace_dir)
+    with jax.profiler.trace(trace_dir):
+        yield
+    log.info("profiler trace written to %s (open with TensorBoard/XProf)",
+             trace_dir)
