@@ -1,0 +1,69 @@
+"""Driver-contract tests for __graft_entry__.
+
+The driver imports the module and calls ``dryrun_multichip(8)`` directly,
+possibly after JAX has already initialized on a 1-device platform (the
+axon tunnel). Round 1 failed exactly there; these tests pin the contract.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_dryrun_multichip_in_process():
+    """Called the way the driver does, on whatever platform is live.
+
+    Under pytest the conftest already forced an 8-device CPU mesh, so this
+    exercises the in-process fast path.
+    """
+    sys.path.insert(0, REPO)
+    try:
+        import __graft_entry__
+
+        __graft_entry__.dryrun_multichip(8)
+    finally:
+        sys.path.remove(REPO)
+
+
+def test_dryrun_multichip_from_one_device_platform():
+    """The exact round-1 failure: JAX already initialized with ONE device
+    when dryrun_multichip(8) is called. Must re-exec into a forced
+    8-device CPU subprocess and succeed."""
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    env["JAX_PLATFORMS"] = "cpu"
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import jax\n"
+        "assert len(jax.devices()) == 1, jax.devices()\n"
+        "import __graft_entry__\n"
+        "__graft_entry__.dryrun_multichip(8)\n" % REPO
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "dryrun_multichip OK" in proc.stdout
+
+
+def test_entry_compiles():
+    sys.path.insert(0, REPO)
+    try:
+        import jax
+
+        import __graft_entry__
+
+        fn, args = __graft_entry__.entry()
+        out = jax.jit(fn)(*args)
+        out.block_until_ready()
+    finally:
+        sys.path.remove(REPO)
