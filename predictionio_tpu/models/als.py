@@ -79,6 +79,12 @@ class ALSConfig:
     #: quality matches the exact solvers (see test_als solver parity).
     #: Raise for small-λ / ill-conditioned setups, or set solver="cholesky".
     cg_iters: int = DEFAULT_CG_ITERS
+    #: shard the factor matrices' rows over the mesh's ``model`` axis
+    #: (tensor-parallel factors, ALX-style). Requires a mesh with a
+    #: ``model`` axis; silently equivalent to replicated when that axis
+    #: has size 1. The math is identical — XLA inserts the all-gathers the
+    #: cross-shard factor gathers need.
+    model_sharded: bool = False
     seed: int = 7
 
 
@@ -142,6 +148,10 @@ def _run_fingerprint(ratings: Ratings, config: ALSConfig) -> int:
     # iteration target is legitimate resume (the `it <= iterations` check
     # handles checkpoints past the current target)
     cfg_d.pop("iterations", None)
+    # model_sharded excluded: it changes array placement, not the math —
+    # a replicated-run checkpoint is resumable under factor sharding and
+    # vice versa
+    cfg_d.pop("model_sharded", None)
     cfg_js = json.dumps(cfg_d, sort_keys=True, default=str)
     parts = (
         zlib.crc32(np.ascontiguousarray(ratings.user_indices).tobytes()),
@@ -344,7 +354,28 @@ def train_als(ratings: Ratings, config: ALSConfig, mesh=None, *,
 
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    rep = NamedSharding(mesh, P())
+    model_sharded = bool(config.model_sharded)
+    if model_sharded and "model" not in mesh.axis_names:
+        log.warning("model_sharded requested but mesh %s has no 'model' "
+                    "axis; training with replicated factors", dict(mesh.shape))
+        model_sharded = False
+    # factor matrices: rows over the model axis when tensor-parallel,
+    # replicated otherwise — initial v, restored checkpoints, and the train
+    # step's outputs all use the same placement. NamedSharding requires dim
+    # 0 divisible by the model-axis size, so the on-device factor matrices
+    # are row-padded to nu_p/ni_p; blocks only ever gather rows < true
+    # size, and everything host-facing (checkpoints, the final model) is
+    # sliced back to true size.
+    ms_size = mesh.shape["model"] if model_sharded else 1
+    nu_p = -(-nu // ms_size) * ms_size
+    ni_p = -(-ni // ms_size) * ms_size
+    fac = NamedSharding(mesh, P("model" if model_sharded else None, None))
+
+    def _pad_rows(arr, n_pad):
+        if arr.shape[0] == n_pad:
+            return arr
+        return jnp.concatenate(
+            [arr, jnp.zeros((n_pad - arr.shape[0],) + arr.shape[1:], arr.dtype)])
     u_bk = _put_buckets(user_buckets, mesh)
     i_bk = _put_buckets(item_buckets, mesh)
 
@@ -353,12 +384,20 @@ def train_als(ratings: Ratings, config: ALSConfig, mesh=None, *,
     # would silently return a model of the wrong run
     fp = _run_fingerprint(ratings, config)
 
-    def _resumable(state) -> bool:
+    def _same_run(state) -> bool:
         v_arr, u_arr = state.get("v"), state.get("u")
         return (state.get("fp") is not None and int(state["fp"]) == fp
                 and v_arr is not None and u_arr is not None
-                and v_arr.shape == (ni, rank) and u_arr.shape == (nu, rank)
-                and int(state["it"]) <= config.iterations)
+                and v_arr.shape == (ni, rank) and u_arr.shape == (nu, rank))
+
+    saw_same_run = False
+
+    def _resumable(state) -> bool:
+        nonlocal saw_same_run
+        if not _same_run(state):
+            return False
+        saw_same_run = True
+        return int(state["it"]) <= config.iterations
 
     start_it = 0
     v = None
@@ -368,29 +407,41 @@ def train_als(ratings: Ratings, config: ALSConfig, mesh=None, *,
         if restored is not None:
             ck_step, state = restored
             start_it = int(state["it"])
-            v = jax.device_put(jnp.asarray(state["v"]), rep)
-            u_restored = jax.device_put(jnp.asarray(state["u"]), rep)
+            v = jax.device_put(_pad_rows(jnp.asarray(state["v"]), ni_p), fac)
+            u_restored = jax.device_put(
+                _pad_rows(jnp.asarray(state["u"]), nu_p), fac)
             log.info("resuming ALS from checkpoint step %d (iter %d)",
                      ck_step, start_it)
         elif checkpointer.steps():
-            # only stale steps exist; purge them or retention would keep
-            # preferring them over this run's fresh (lower-numbered) saves
-            log.warning("no resumable checkpoint (data/config changed); "
-                        "clearing %d stale step(s) and starting fresh",
-                        len(checkpointer.steps()))
-            checkpointer.clear()
+            if saw_same_run:
+                # same data+config, just trained past the current target:
+                # those checkpoints stay valid for a later higher-target
+                # run — keep them (retention only prunes steps <= the one
+                # being saved, so this run's fresh saves are safe)
+                log.warning(
+                    "checkpoint steps exist beyond the current iteration "
+                    "target (%d); keeping them and training fresh",
+                    config.iterations)
+            else:
+                # genuinely stale (data/config changed); purge or retention
+                # would prefer them over this run's fresh saves
+                log.warning("no resumable checkpoint (data/config changed); "
+                            "clearing %d stale step(s) and starting fresh",
+                            len(checkpointer.steps()))
+                checkpointer.clear()
     if v is None:
         key = jax.random.PRNGKey(config.seed)
         _k_u, k_v = jax.random.split(key)
         # MLlib-style init: small positive factors
         v = jax.device_put(
-            jnp.abs(jax.random.normal(k_v, (ni, rank), dtype=jnp.float32)) / jnp.sqrt(rank),
-            rep,
+            jnp.abs(jax.random.normal(k_v, (ni_p, rank), dtype=jnp.float32)) / jnp.sqrt(rank),
+            fac,
         )
 
     step = make_train_step(
         mesh, rank=rank, lambda_=config.lambda_,
-        implicit=config.implicit_prefs, alpha=config.alpha, nu=nu, ni=ni,
+        implicit=config.implicit_prefs, alpha=config.alpha, nu=nu_p, ni=ni_p,
+        model_sharded=model_sharded,
         compute_dtype=config.compute_dtype, solver=config.solver,
         cg_iters=config.cg_iters,
     )
@@ -401,13 +452,17 @@ def train_als(ratings: Ratings, config: ALSConfig, mesh=None, *,
         if (checkpointer is not None and checkpoint_every > 0
                 and (done % checkpoint_every == 0 or done == config.iterations)):
             # both sides: the final model pairs u_k (solved from v_{k-1})
-            # with v_k, so v alone cannot reconstruct it exactly
-            checkpointer.save(done, {"u": u, "v": v, "it": np.int64(done),
+            # with v_k, so v alone cannot reconstruct it exactly.
+            # checkpoints hold true-size (unpadded) arrays — they must be
+            # resumable on a mesh with a different model-axis size
+            checkpointer.save(done, {"u": np.asarray(u)[:nu],
+                                     "v": np.asarray(v)[:ni],
+                                     "it": np.int64(done),
                                      "fp": np.uint64(fp)})
     if u is None:
         # checkpoint was already at the final iteration
         u = u_restored if u_restored is not None else _solve_side(
-            u_bk, v, nu, kw=dict(
+            u_bk, v, nu_p, kw=dict(
                 lambda_=config.lambda_, implicit=config.implicit_prefs,
                 alpha=config.alpha, rank=rank,
                 compute_dtype=config.compute_dtype, solver=config.solver,
@@ -416,8 +471,8 @@ def train_als(ratings: Ratings, config: ALSConfig, mesh=None, *,
     log.info("ALS done: %d iters, U %s, V %s", config.iterations, (nu, rank), (ni, rank))
 
     return ALSModel(
-        user_factors=np.asarray(u),
-        item_factors=np.asarray(v),
+        user_factors=np.asarray(u)[:nu],
+        item_factors=np.asarray(v)[:ni],
         user_ids=ratings.user_ids,
         item_ids=ratings.item_ids,
         config=config,

@@ -90,7 +90,40 @@ class TrainCheckpointer:
                 self._backend = _NpzBackend()
 
     # -- steps -------------------------------------------------------------
+    def _recover(self) -> None:
+        """Repair interrupted overwrites. save() swaps via ``step_N.tmp``
+        and ``step_N.old`` siblings; a crash can leave any combination of
+        them. Rules: a COMPLETE ``.tmp`` is a finished newer save — promote
+        it over ``step_N``; an incomplete ``.tmp`` is garbage; ``.old`` is
+        the displaced previous checkpoint — restore it only if ``step_N``
+        vanished mid-swap, else delete."""
+        if not self.directory.is_dir():
+            return
+        for tmp in self.directory.glob("step_*.tmp"):
+            name = tmp.name[: -len(".tmp")]
+            if not _STEP_RE.match(name):
+                continue
+            final = self.directory / name
+            if (tmp / "_COMPLETE").exists():
+                if final.exists():
+                    shutil.rmtree(final)
+                tmp.rename(final)
+                log.warning("recovered interrupted checkpoint save: %s", name)
+            else:
+                shutil.rmtree(tmp, ignore_errors=True)
+        for old in self.directory.glob("step_*.old"):
+            name = old.name[: -len(".old")]
+            if not _STEP_RE.match(name):
+                continue
+            final = self.directory / name
+            if not final.exists() and (old / "_COMPLETE").exists():
+                old.rename(final)
+                log.warning("restored displaced checkpoint: %s", name)
+            else:
+                shutil.rmtree(old, ignore_errors=True)
+
     def steps(self) -> list[int]:
+        self._recover()
         if not self.directory.is_dir():
             return []
         out = []
@@ -109,16 +142,36 @@ class TrainCheckpointer:
 
     # -- save / restore ----------------------------------------------------
     def save(self, step: int, state: Any) -> None:
-        """Write atomically: the step counts only once _COMPLETE lands."""
+        """Write atomically: the step counts only once _COMPLETE lands.
+
+        Overwrites are atomic too — the new state is written to a ``.tmp``
+        sibling and swapped in, so a crash mid-overwrite never loses the
+        previously complete checkpoint of the same step.
+        """
         self.directory.mkdir(parents=True, exist_ok=True)
+        self._recover()  # settle any interrupted swap before starting ours
         path = self._step_dir(step)
+        tmp = self.directory / f"step_{step}.tmp"
+        if tmp.exists():  # leftover from a crashed save
+            shutil.rmtree(tmp)
+        self._backend.save(tmp, state)
+        (tmp / "_COMPLETE").write_text(json.dumps({"step": step}))
         if path.exists():
-            shutil.rmtree(path)
-        self._backend.save(path, state)
-        (path / "_COMPLETE").write_text(json.dumps({"step": step}))
+            old = self.directory / f"step_{step}.old"
+            if old.exists():
+                shutil.rmtree(old)
+            path.rename(old)
+            tmp.rename(path)
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            tmp.rename(path)
         log.info("checkpoint saved: step %d -> %s", step, path)
-        for old in self.steps()[: -self.keep]:
-            shutil.rmtree(self._step_dir(old), ignore_errors=True)
+        # Retention prunes only steps <= the one just saved: steps beyond it
+        # can exist legitimately (same run previously trained to a higher
+        # iteration target) and must not shadow-delete the fresh save.
+        eligible = [s for s in self.steps() if s <= step]
+        for old_step in eligible[: -self.keep]:
+            shutil.rmtree(self._step_dir(old_step), ignore_errors=True)
 
     def restore(self, step: int | None = None) -> tuple[int, Any] | None:
         """(step, state) for ``step`` or the latest; None when empty."""

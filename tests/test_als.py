@@ -201,3 +201,69 @@ def test_solver_parity_implicit(rng):
     p_ex = m_ex.user_factors @ m_ex.item_factors.T
     denom = np.abs(p_ex).max() + 1e-9
     assert np.max(np.abs(p_cg - p_ex)) / denom < 5e-3
+
+
+def test_model_sharded_matches_replicated(rng, mesh8):
+    """Tensor-parallel factor sharding (ALSConfig.model_sharded) must be a
+    pure placement change: same math as replicated training (the TPU analog
+    of the reference distributing factor RDDs across executors,
+    examples/.../custom-serving/src/main/scala/ALSModel.scala:172-219)."""
+    import dataclasses
+
+    ratings, full, mask = make_ratings(rng)
+    cfg = ALSConfig(rank=8, iterations=5, lambda_=0.01, solver="cholesky")
+    m_rep = train_als(ratings, cfg, mesh=mesh8)
+    m_ms = train_als(
+        ratings, dataclasses.replace(cfg, model_sharded=True), mesh=mesh8)
+    np.testing.assert_allclose(
+        m_ms.user_factors, m_rep.user_factors, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(
+        m_ms.item_factors, m_rep.item_factors, rtol=2e-4, atol=2e-5)
+
+
+def test_model_sharded_mesh_shape_invariance(rng, mesh8):
+    """(4,2) data x model mesh must equal an (8,1) pure-data mesh."""
+    import dataclasses
+
+    from predictionio_tpu.parallel.mesh import make_mesh
+
+    mesh81 = make_mesh((8, 1), ("data", "model"))
+    ratings, full, mask = make_ratings(rng)
+    cfg = ALSConfig(rank=8, iterations=5, lambda_=0.01, solver="cholesky",
+                    model_sharded=True)
+    m_42 = train_als(ratings, cfg, mesh=mesh8)
+    m_81 = train_als(ratings, cfg, mesh=mesh81)
+    np.testing.assert_allclose(
+        m_42.user_factors, m_81.user_factors, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(
+        m_42.item_factors, m_81.item_factors, rtol=2e-4, atol=2e-5)
+
+
+def test_model_sharded_without_model_axis_falls_back(rng):
+    """A mesh lacking a 'model' axis trains replicated with a warning, not
+    an error."""
+    from predictionio_tpu.parallel.mesh import make_mesh
+
+    mesh_d = make_mesh((8,), ("data",))
+    ratings, _, _ = make_ratings(rng, nu=30, ni=20)
+    cfg = ALSConfig(rank=4, iterations=2, model_sharded=True)
+    model = train_als(ratings, cfg, mesh=mesh_d)
+    assert np.isfinite(model.user_factors).all()
+
+
+def test_model_sharded_odd_sizes(rng, mesh8):
+    """nu/ni not divisible by the model-axis size must work (on-device
+    row padding) and match replicated training."""
+    import dataclasses
+
+    ratings, full, mask = make_ratings(rng, nu=61, ni=31)
+    cfg = ALSConfig(rank=8, iterations=4, lambda_=0.01, solver="cholesky")
+    m_rep = train_als(ratings, cfg, mesh=mesh8)
+    m_ms = train_als(
+        ratings, dataclasses.replace(cfg, model_sharded=True), mesh=mesh8)
+    assert m_ms.user_factors.shape == (61, 8)
+    assert m_ms.item_factors.shape == (31, 8)
+    np.testing.assert_allclose(
+        m_ms.user_factors, m_rep.user_factors, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(
+        m_ms.item_factors, m_rep.item_factors, rtol=2e-4, atol=2e-5)

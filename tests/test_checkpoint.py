@@ -160,3 +160,73 @@ class TestALSResume:
         m_fresh = train_als(r, cfg6)
         np.testing.assert_allclose(m.item_factors, m_fresh.item_factors,
                                    rtol=1e-5, atol=1e-5)
+
+    def test_lower_target_keeps_same_run_checkpoints(self, tmp_path):
+        """Re-running with a LOWER iteration target than previously
+        checkpointed must not destroy the same run's valid higher-step
+        checkpoints — they stay usable for a later higher-target run."""
+        r = _ratings()
+        ck = TrainCheckpointer(tmp_path / "als")
+        cfg6 = ALSConfig(rank=8, iterations=6, lambda_=0.1, seed=5)
+        train_als(r, cfg6, checkpointer=ck, checkpoint_every=1)
+        assert ck.steps() == [5, 6]
+        cfg3 = ALSConfig(rank=8, iterations=3, lambda_=0.1, seed=5)
+        m3 = train_als(r, cfg3, checkpointer=ck, checkpoint_every=1)
+        m3_fresh = train_als(r, cfg3)
+        np.testing.assert_allclose(m3.item_factors, m3_fresh.item_factors,
+                                   rtol=1e-5, atol=1e-5)
+        # higher-step checkpoints survived; own steps saved alongside
+        assert 6 in ck.steps() and 3 in ck.steps()
+        # raising the target back to 6 resumes from step 6 exactly
+        m6 = train_als(r, cfg6, checkpointer=ck, checkpoint_every=1)
+        m6_fresh = train_als(r, cfg6)
+        np.testing.assert_allclose(m6.item_factors, m6_fresh.item_factors,
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestOverwriteAtomicity:
+    def test_overwrite_same_step(self, ckptr_factory):
+        ck = ckptr_factory()
+        ck.save(2, {"v": np.zeros((2, 2)), "it": np.int64(2)})
+        ck.save(2, {"v": np.ones((2, 2)), "it": np.int64(2)})
+        step, st = ck.restore()
+        assert step == 2 and float(st["v"][0, 0]) == 1.0
+        assert not (ck.directory / "step_2.tmp").exists()
+        assert not (ck.directory / "step_2.old").exists()
+
+    def test_leftover_tmp_ignored_and_cleaned(self, ckptr_factory):
+        ck = ckptr_factory()
+        ck.save(1, {"v": np.zeros((2, 2)), "it": np.int64(1)})
+        # simulate a crash mid-overwrite: tmp dir present, original intact
+        (ck.directory / "step_1.tmp").mkdir()
+        assert ck.steps() == [1]
+        ck.save(1, {"v": np.ones((2, 2)), "it": np.int64(1)})
+        _, st = ck.restore()
+        assert float(st["v"][0, 0]) == 1.0
+
+    def test_crash_between_swap_renames_recovers(self, ckptr_factory):
+        """Crash window: step_N renamed to .old but .tmp not yet promoted —
+        the COMPLETE .tmp must be recovered as step_N."""
+        ck = ckptr_factory()
+        ck.save(3, {"v": np.zeros((2, 2)), "it": np.int64(3)})
+        d = ck.directory
+        # reconstruct the mid-swap state by hand
+        (d / "step_3").rename(d / "step_3.old")
+        ck2 = ckptr_factory()
+        ck2.save(3, {"v": np.ones((2, 2)), "it": np.int64(3)})
+        # ...but first simulate: old present + complete tmp, no final
+        (d / "step_3").rename(d / "step_3.tmp")
+        assert ck2.steps() == [3]  # recovery promoted the tmp
+        _, st = ck2.restore()
+        assert float(st["v"][0, 0]) == 1.0
+        assert not (d / "step_3.old").exists()
+        assert not (d / "step_3.tmp").exists()
+
+    def test_displaced_old_restored_when_final_missing(self, ckptr_factory):
+        ck = ckptr_factory()
+        ck.save(4, {"v": np.full((2, 2), 7.0), "it": np.int64(4)})
+        d = ck.directory
+        (d / "step_4").rename(d / "step_4.old")  # crash before tmp landed
+        assert ck.steps() == [4]
+        _, st = ck.restore()
+        assert float(st["v"][0, 0]) == 7.0
