@@ -150,14 +150,21 @@ class PersistentModel(abc.ABC):
 class LocalFileSystemPersistentModel(PersistentModel):
     """Pickle-to-disk PersistentModel helper (reference: controller/
     LocalFileSystemPersistentModel.scala saves via the local FS; here the
-    path is ``$PIO_HOME/pmodels/<class>-<instance_id>.pkl``)."""
+    path is ``$PIO_HOME/pmodels/<class>-<instance_id>.pkl``).
+
+    Trust model: ``load`` unpickles, and unpickling executes code — the
+    same assumption the reference makes Kryo-deserializing MODELDATA
+    blobs (CreateServer.scala:61-75): the model store is as trusted as
+    the code deploying it. The pmodels directory is created 0o700 so
+    other local users cannot plant a model file; do not point PIO_HOME at
+    storage writable by less-trusted principals."""
 
     @classmethod
     def _path(cls, instance_id: str):
         from ..storage.registry import Storage
 
         d = Storage.home() / "pmodels"
-        d.mkdir(parents=True, exist_ok=True)
+        d.mkdir(parents=True, exist_ok=True, mode=0o700)
         return d / f"{cls.__name__}-{instance_id}.pkl"
 
     def save(self, instance_id: str, params: Any) -> bool:

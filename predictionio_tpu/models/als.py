@@ -59,9 +59,11 @@ class ALSConfig:
     lambda_: float = 0.1
     implicit_prefs: bool = False
     alpha: float = 1.0  # implicit confidence scale
-    #: degree tiers of the bucketed layout (rows grouped by degree; only
-    #: degrees beyond the last tier are subsampled)
-    tiers: tuple = (128, 1024, 8192, 65536)
+    #: degree tiers of the bucketed layout. "auto" (default) derives
+    #: geometric tiers from the observed max degree — zero dropped entries
+    #: and ~20% average padding; an explicit tuple is auto-extended to the
+    #: observed max so it is lossless too (ops/neighbors.py)
+    tiers: tuple | str = "auto"
     #: per-block gather budget in elements (B*D cap) — bounds peak memory
     gather_budget: int = 2_000_000
     #: "bfloat16" halves the HBM traffic of the factor gather and runs the
@@ -169,7 +171,8 @@ def _run_fingerprint(ratings: Ratings, config: ALSConfig) -> int:
 # the pjit'd half-step
 # ---------------------------------------------------------------------------
 
-def _spd_solve(a, b, *, solver="cg", cg_iters=DEFAULT_CG_ITERS):
+def _spd_solve(a, b, *, solver="cg", cg_iters=DEFAULT_CG_ITERS,
+               matvec_dtype=None):
     """Batched SPD solve, [B, R, R] x [B, R].
 
     "cg": fixed-iteration conjugate gradient — every step is a batched
@@ -181,6 +184,13 @@ def _spd_solve(a, b, *, solver="cg", cg_iters=DEFAULT_CG_ITERS):
     1e-3..1e-5 — fine as the inner solver of an alternating sweep (the
     next half-step corrects), not as a general linear solver.
     "cholesky"/"lu": exact factorizations (cholesky ≈ 2x LU).
+
+    ``matvec_dtype=bfloat16`` runs the A·p matvec with a bf16 copy of A
+    (f32 accumulation, f32 residual/search-vector updates): CG is HBM-
+    bound on re-reading the [B, R, R] gramians every iteration, so this
+    halves its traffic. The perturbed matvec only loosens the inner
+    residual, which the next ALS half-step absorbs (bench accuracy gate
+    pins the end-model quality).
     """
     import jax
     import jax.numpy as jnp
@@ -195,9 +205,14 @@ def _spd_solve(a, b, *, solver="cg", cg_iters=DEFAULT_CG_ITERS):
             chol, y, left_side=True, lower=True, transpose_a=True)
         return x.squeeze(-1)
 
+    f32 = jnp.float32
+    mdt = jnp.dtype(matvec_dtype) if matvec_dtype is not None else a.dtype
+    a_m = a.astype(mdt)
+
     def body(_, carry):
         x, r, p, rs = carry
-        ap = jnp.einsum("brs,bs->br", a, p)
+        ap = jnp.einsum("brs,bs->br", a_m, p.astype(mdt),
+                        preferred_element_type=f32)
         alpha = rs / jnp.maximum(jnp.einsum("br,br->b", p, ap), 1e-30)
         x = x + alpha[:, None] * p
         r = r - alpha[:, None] * ap
@@ -211,16 +226,22 @@ def _spd_solve(a, b, *, solver="cg", cg_iters=DEFAULT_CG_ITERS):
     return x
 
 
-def _half_step(ids, vals, mask, other, *, lambda_, implicit, alpha, rank,
+def _half_step(ids, vals, other, *, lambda_, implicit, alpha, rank,
                compute_dtype="float32", solver="cg", cg_iters=DEFAULT_CG_ITERS):
     """Solve all rows of one side given the other side's factors.
 
-    ids/vals/mask: [NB, B, D]; other: [NO, R] (replicated).
+    ids/vals: [NB, B, D]; other: [NO, R] (replicated).
     Returns [NB, B, R] float32.
+
+    Validity derives from ``vals != 0``: the layout (ops/neighbors.py)
+    zeroes padded slots and nudges genuine zero ratings to 1e-30, so no
+    separate mask array rides along — that array was a third of the
+    layout's HBM traffic and host->device transfer at 20M-rating scale.
 
     ``compute_dtype="bfloat16"`` casts the gathered factors and weights to
     bf16 (half the HBM bytes on the gather — the bandwidth-bound part) and
-    runs the einsums with f32 accumulation; the solve is always f32.
+    runs the einsums with f32 accumulation; the solve's vector updates
+    stay f32 (its matvec rides bf16 too, see _spd_solve).
     """
     import jax
     import jax.numpy as jnp
@@ -235,31 +256,39 @@ def _half_step(ids, vals, mask, other, *, lambda_, implicit, alpha, rank,
                           preferred_element_type=f32)  # [R, R] — the VᵀV trick
 
     def solve_block(blk):
-        b_ids, b_vals, b_mask = blk
+        b_ids, b_vals = blk
+        valid = b_vals != 0  # [B, D] — padded slots are exactly 0
         f = other_c[b_ids]  # [B, D, R] gather — bf16 halves this traffic
-        f = f * b_mask[..., None].astype(cdt)
+        f = f * valid.astype(cdt)[..., None]
+        vals_f32 = b_vals.astype(f32)
         if implicit:
-            conf = 1.0 + alpha * b_vals  # confidence
-            cw = ((conf - 1.0) * b_mask).astype(cdt)
+            # confidence c = 1 + alpha*r; (c-1) is 0 at padded slots already
+            cw = (alpha * vals_f32).astype(cdt)
             a = gram[None] + jnp.einsum("bd,bdr,bds->brs", cw, f, f,
                                         preferred_element_type=f32)
             a = a + lambda_ * eye[None]
-            b = jnp.einsum("bd,bdr->br", (conf * b_mask).astype(cdt), f,
+            b = jnp.einsum("bd,bdr->br",
+                           ((1.0 + alpha * vals_f32)
+                            * valid.astype(f32)).astype(cdt), f,
                            preferred_element_type=f32)
         else:
             a = jnp.einsum("bdr,bds->brs", f, f, preferred_element_type=f32)
-            n_u = b_mask.sum(axis=1)  # ALS-WR: λ·n_u·I
+            n_u = jnp.sum(valid, axis=1).astype(f32)  # ALS-WR: λ·n_u·I
             a = a + (lambda_ * jnp.maximum(n_u, 1.0))[:, None, None] * eye[None]
-            b = jnp.einsum("bd,bdr->br", (b_vals * b_mask).astype(cdt), f,
+            b = jnp.einsum("bd,bdr->br", b_vals.astype(cdt), f,
                            preferred_element_type=f32)
-        return _spd_solve(a, b, solver=solver, cg_iters=cg_iters)
+        return _spd_solve(a, b, solver=solver, cg_iters=cg_iters,
+                          matvec_dtype=cdt)
 
-    return jax.lax.map(solve_block, (ids, vals, mask))
+    return jax.lax.map(solve_block, (ids, vals))
 
 
-def _put_buckets(buckets, mesh):
+def _put_buckets(buckets, mesh, *, vals_dtype=None):
     """Device-put one side's buckets: neighbor blocks sharded over the data
-    axis, scatter indices replicated."""
+    axis, scatter indices replicated. No mask upload — validity is encoded
+    in vals (see _half_step). ``vals_dtype=bfloat16`` halves the ratings'
+    transfer + HBM footprint (exact for half-star ratings; otherwise a
+    rounding the bf16 compute path would apply anyway)."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -267,10 +296,15 @@ def _put_buckets(buckets, mesh):
     rep = NamedSharding(mesh, P())
     out = []
     for b in buckets:
+        vals = b.blocks.vals
+        if vals_dtype is not None:
+            import ml_dtypes
+
+            dt = ml_dtypes.bfloat16 if vals_dtype == "bfloat16" else vals_dtype
+            vals = vals.astype(dt)
         out.append({
             "ids": jax.device_put(b.blocks.ids, blk),
-            "vals": jax.device_put(b.blocks.vals, blk),
-            "mask": jax.device_put(b.blocks.mask, blk),
+            "vals": jax.device_put(vals, blk),
             "rows": jax.device_put(b.row_ids, rep),
         })
     return out
@@ -284,7 +318,7 @@ def _solve_side(buckets, other, out_rows, *, kw):
     rank = kw["rank"]
     new = jnp.zeros((out_rows, rank), dtype=jnp.float32)
     for b in buckets:
-        solved = _half_step(b["ids"], b["vals"], b["mask"], other, **kw)
+        solved = _half_step(b["ids"], b["vals"], other, **kw)
         flat = solved.reshape(-1, rank)
         new = new.at[b["rows"]].set(flat, mode="drop")
     return new
@@ -376,8 +410,9 @@ def train_als(ratings: Ratings, config: ALSConfig, mesh=None, *,
             return arr
         return jnp.concatenate(
             [arr, jnp.zeros((n_pad - arr.shape[0],) + arr.shape[1:], arr.dtype)])
-    u_bk = _put_buckets(user_buckets, mesh)
-    i_bk = _put_buckets(item_buckets, mesh)
+    vals_dtype = "bfloat16" if config.compute_dtype == "bfloat16" else None
+    u_bk = _put_buckets(user_buckets, mesh, vals_dtype=vals_dtype)
+    i_bk = _put_buckets(item_buckets, mesh, vals_dtype=vals_dtype)
 
     # run fingerprint: a checkpoint is only resumable for the exact same
     # ratings + config — resuming across changed data or hyperparameters

@@ -121,7 +121,8 @@ def _load() -> ctypes.CDLL | None:
         lib.pio_neighbor_blocks.restype = ctypes.c_int64
         lib.pio_neighbor_blocks.argtypes = [
             i64p, i32p, f32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
-            ctypes.c_uint64, i32p, f32p, f32p,
+            ctypes.c_uint64, i32p, f32p,
+            ctypes.c_void_p,  # mask_out: optional (NULL = don't fill)
         ]
         lib.pio_hash64_batch.restype = None
         lib.pio_hash64_batch.argtypes = [
@@ -147,8 +148,14 @@ def neighbor_blocks_native(
     padded_rows: int,
     d: int,
     seed: int,
-) -> tuple[np.ndarray, np.ndarray, np.ndarray, int] | None:
-    """COO -> padded [padded_rows, d] neighbor layout. None if unavailable."""
+    *,
+    want_mask: bool = False,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray | None, int] | None:
+    """COO -> padded [padded_rows, d] neighbor layout. None if unavailable.
+
+    ``want_mask=False`` (default) skips the mask array entirely — validity
+    is derivable as ``vals != 0`` when the caller epsilon-nudges genuine
+    zero values (ops/neighbors.py does)."""
     lib = _load()
     if lib is None:
         return None
@@ -157,10 +164,11 @@ def neighbor_blocks_native(
     vals = np.ascontiguousarray(vals, np.float32)
     ids = np.zeros((padded_rows, d), np.int32)
     vv = np.zeros((padded_rows, d), np.float32)
-    mask = np.zeros((padded_rows, d), np.float32)
+    mask = np.zeros((padded_rows, d), np.float32) if want_mask else None
     dropped = lib.pio_neighbor_blocks(
         rows, cols, vals, len(rows), num_rows, d,
-        ctypes.c_uint64(seed & 0xFFFFFFFFFFFFFFFF), ids, vv, mask,
+        ctypes.c_uint64(seed & 0xFFFFFFFFFFFFFFFF), ids, vv,
+        mask.ctypes.data_as(ctypes.c_void_p) if mask is not None else None,
     )
     if dropped < 0:
         raise ValueError("pio_neighbor_blocks: invalid input")

@@ -49,7 +49,8 @@ extern "C" {
 // ---------------------------------------------------------------------------
 // rows[n] (int64, 0..num_rows-1), cols[n] (int32), vals[n] (f32).
 // Outputs are caller-allocated, ZERO-INITIALIZED row-major [padded_rows, d]
-// (padded_rows >= num_rows). Entries beyond the per-row degree cap d are
+// (padded_rows >= num_rows); mask_out may be NULL (validity is derivable
+// as vals != 0 when the caller nudges genuine zero values to an epsilon). Entries beyond the per-row degree cap d are
 // dropped by keeping the d smallest (subsample_key, pos) pairs, preserving
 // the original relative order of kept entries. Returns the number of
 // dropped entries, or -1 on bad input.
@@ -81,7 +82,7 @@ int64_t pio_neighbor_blocks(const int64_t* rows, const int32_t* cols,
       int64_t slot = r * d + cursor[static_cast<size_t>(r)]++;
       ids_out[slot] = cols[i];
       vals_out[slot] = vals[i];
-      mask_out[slot] = 1.0f;
+      if (mask_out) mask_out[slot] = 1.0f;
     }
     return 0;
   }
@@ -103,7 +104,7 @@ int64_t pio_neighbor_blocks(const int64_t* rows, const int32_t* cols,
       int64_t slot = r * d + cursor[static_cast<size_t>(r)]++;
       ids_out[slot] = cols[i];
       vals_out[slot] = vals[i];
-      mask_out[slot] = 1.0f;
+      if (mask_out) mask_out[slot] = 1.0f;
     } else {
       pending[static_cast<size_t>(ov)].push_back(i);
     }
@@ -131,7 +132,7 @@ int64_t pio_neighbor_blocks(const int64_t* rows, const int32_t* cols,
       int64_t slot = r * d + c++;
       ids_out[slot] = cols[i];
       vals_out[slot] = vals[i];
-      mask_out[slot] = 1.0f;
+      if (mask_out) mask_out[slot] = 1.0f;
     }
     dropped += cnt - d;
   }

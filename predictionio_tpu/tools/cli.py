@@ -62,11 +62,10 @@ def _engine_from_variant(engine_dir: Path, variant: dict):
     factory = variant.get("engineFactory")
     if not factory:
         _die("engine.json has no engineFactory field")
-    sys.path.insert(0, str(engine_dir))
-    try:
-        return resolve_engine_factory(factory)
-    finally:
-        pass  # keep path: deploy/predict needs the module importable
+    # dir-scoped import: each engine's `engine` module gets a unique
+    # module name, so training/deploying several engines in one process
+    # never cross-wires their code (workflow/core_workflow.py)
+    return resolve_engine_factory(factory, engine_dir=engine_dir)
 
 
 def _engine_ids(engine_dir: Path, variant: dict) -> tuple[str, str, str]:
@@ -276,11 +275,11 @@ def cmd_eval(args) -> int:
     from ..workflow import Context, resolve_attr, run_evaluation
 
     engine_dir = Path(args.engine_dir)
-    sys.path.insert(0, str(engine_dir))
-    ev_obj = resolve_attr(args.evaluation)
+    ev_obj = resolve_attr(args.evaluation, engine_dir=engine_dir)
     evaluation = ev_obj() if isinstance(ev_obj, type) else ev_obj
     if args.engine_params_generator:
-        gen_obj = resolve_attr(args.engine_params_generator)
+        gen_obj = resolve_attr(args.engine_params_generator,
+                               engine_dir=engine_dir)
         generator = gen_obj() if isinstance(gen_obj, type) else gen_obj
         grid = list(generator.engine_params_list)
     else:
@@ -324,6 +323,7 @@ def cmd_deploy(args) -> int:
         access_key=args.accesskey,
         batch_window_ms=args.batch_window_ms,
         batch_max=args.batch_max,
+        engine_dir=engine_dir,
     )
     return 0
 

@@ -175,6 +175,7 @@ class TrainCheckpointer:
 
     def restore(self, step: int | None = None) -> tuple[int, Any] | None:
         """(step, state) for ``step`` or the latest; None when empty."""
+        self._recover()  # an explicit step may need an interrupted-swap repair
         if step is None:
             step = self.latest_step()
         if step is None:

@@ -13,6 +13,7 @@ import dataclasses
 import importlib
 import json
 import logging
+import sys
 import traceback
 from datetime import datetime, timezone
 from typing import Any, Sequence
@@ -27,6 +28,7 @@ from .serialization import (
     PersistentModelManifest,
     RetrainMarker,
     deserialize_models,
+    plain_module_name,
     serialize_models,
 )
 
@@ -38,28 +40,110 @@ __all__ = [
 ]
 
 
-def resolve_attr(path: str) -> Any:
+def _import_engine_scoped(engine_dir, mod_name: str):
+    """Import ``mod_name`` from ``engine_dir`` under a dir-unique FLAT
+    module name (``_pio_engine_<dirhash>_<name>``), so that two engines
+    whose modules share a name — every template calls its module
+    ``engine`` — coexist in one process. This replaces the old permanent
+    ``sys.path`` prepend, which made a second engine's ``import engine``
+    silently resolve to the first engine's code.
+
+    The flat (dot-free) name keeps pickle round-trips working: classes
+    defined in the module carry it as ``__module__``, and unpickling
+    re-imports it straight from ``sys.modules`` with no parent package
+    needed (serialization.py additionally re-resolves names against the
+    engine dir, so blobs survive a moved project). Returns None when
+    ``engine_dir`` has no such module (caller falls back to a regular
+    import).
+
+    Sibling-module semantics: imports the module body makes eagerly are
+    engine-correct (the dir is FIRST on sys.path during exec, and the
+    plain-named entries are evicted afterwards); the dir then stays
+    APPENDED to sys.path so lazy imports at predict/serve time still
+    resolve. With several engines whose *siblings* share names, a lazy
+    sibling import binds by sys.path order — prefer eager imports in
+    engine modules.
+    """
+    import hashlib
+    import importlib.util
+    from pathlib import Path
+
+    top, _, rest = mod_name.partition(".")
+    d = Path(engine_dir).resolve()
+    file = d / f"{top}.py"
+    pkg = d / top / "__init__.py"
+    if not file.exists() and not pkg.exists():
+        return None
+    key = hashlib.sha1(str(d).encode()).hexdigest()[:10]
+    uniq_top = f"_pio_engine_{key}_{top}"
+    if uniq_top not in sys.modules:
+        if file.exists():
+            spec = importlib.util.spec_from_file_location(uniq_top, file)
+        else:
+            spec = importlib.util.spec_from_file_location(
+                uniq_top, pkg, submodule_search_locations=[str(d / top)])
+        module = importlib.util.module_from_spec(spec)
+        sys.modules[uniq_top] = module
+        # engine-dir on sys.path ONLY while the module body executes, so
+        # it can import sibling helper files
+        sys.path.insert(0, str(d))
+        try:
+            spec.loader.exec_module(module)
+        except BaseException:
+            sys.modules.pop(uniq_top, None)
+            raise
+        finally:
+            try:
+                sys.path.remove(str(d))
+            except ValueError:
+                pass
+            if str(d) not in sys.path:
+                sys.path.append(str(d))  # lazy serve-time imports
+            # sibling modules the body imported by plain name (e.g.
+            # `from data_source import X`) were cached under that plain
+            # name — evict them so another engine's same-named sibling
+            # loads ITS file; the importer keeps its direct references
+            for name, m in list(sys.modules.items()):
+                f = getattr(m, "__file__", None)
+                if (f and "." not in name
+                        and not name.startswith("_pio_engine_")
+                        and Path(f).parent == d):
+                    sys.modules.pop(name, None)
+    if rest:
+        return importlib.import_module(f"{uniq_top}.{rest}")
+    return sys.modules[uniq_top]
+
+
+def resolve_attr(path: str, *, engine_dir=None) -> Any:
     """'pkg.module.Attr' or 'pkg.module:Attr' -> attribute. The analog of
     WorkflowUtils.getEngine's object/class reflection (WorkflowUtils.scala:
-    60-99) with explicit module paths instead of classpath scanning."""
+    60-99) with explicit module paths instead of classpath scanning.
+
+    With ``engine_dir``, modules found in that directory are imported
+    under a dir-unique name (see _import_engine_scoped) so multiple
+    engines coexist in-process; other module paths import normally."""
     if ":" in path:
         mod_name, attr = path.split(":", 1)
     else:
         mod_name, _, attr = path.rpartition(".")
     if not mod_name:
         raise ValueError(f"cannot resolve {path!r}: need 'module.Attr'")
-    module = importlib.import_module(mod_name)
+    module = None
+    if engine_dir is not None:
+        module = _import_engine_scoped(engine_dir, mod_name)
+    if module is None:
+        module = importlib.import_module(mod_name)
     obj = module
     for part in attr.split("."):
         obj = getattr(obj, part)
     return obj
 
 
-def resolve_engine_factory(path: str) -> Engine:
+def resolve_engine_factory(path: str, *, engine_dir=None) -> Engine:
     """Resolve an engineFactory string to an Engine instance. Accepts: an
     EngineFactory subclass, an instance, a function returning an Engine,
     or an Engine object."""
-    obj = resolve_attr(path)
+    obj = resolve_attr(path, engine_dir=engine_dir)
     if isinstance(obj, Engine):
         return obj
     candidates = []
@@ -105,7 +189,11 @@ def _persistable(result: TrainResult, instance_id: str) -> list[Any]:
             if saved:
                 out.append(
                     PersistentModelManifest(
-                        class_name=type(model).__name__, module=type(model).__module__
+                        class_name=type(model).__name__,
+                        # plain name: the dir-scoped prefix embeds the
+                        # engine dir's path hash, which must not leak
+                        # into durable blobs (serialization.py)
+                        module=plain_module_name(type(model).__module__),
                     )
                 )
             else:
@@ -225,11 +313,17 @@ def run_evaluation(
 
 
 def prepare_deploy(
-    engine: Engine, instance: EngineInstance, ctx: Context | None = None
+    engine: Engine, instance: EngineInstance, ctx: Context | None = None,
+    *, engine_dir=None,
 ) -> TrainResult:
     """Rehydrate models for serving (Engine.prepareDeploy, Engine.scala:
     174-243): deserialize stored models; PersistentModelManifest -> call
-    the class's ``load``; RetrainMarker -> retrain from the stored params."""
+    the class's ``load``; RetrainMarker -> retrain from the stored params.
+
+    ``engine_dir`` lets classes referenced by the blob or a manifest be
+    re-resolved from the deploying engine's directory, so blobs survive a
+    moved/renamed project or a different host (the reference re-resolves
+    via its registered jar classpath, CreateServer.scala:61-75)."""
     ctx = ctx or Context(mode="Serving")
     engine_params = engine_params_from_instance(engine, instance)
     names, algos = engine.make_algorithms(engine_params)
@@ -238,7 +332,7 @@ def prepare_deploy(
     blob = Storage.get_models().get(instance.id)
     if blob is None:
         raise RuntimeError(f"no model blob for engine instance {instance.id}")
-    stored = deserialize_models(blob.models)
+    stored = deserialize_models(blob.models, engine_dir=engine_dir)
 
     models: list[Any] = []
     needs_retrain = any(isinstance(m, RetrainMarker) for m in stored)
@@ -249,7 +343,13 @@ def prepare_deploy(
         retrained = engine.train(ctx, engine_params)
     for i, (m, algo) in enumerate(zip(stored, algos)):
         if isinstance(m, PersistentModelManifest):
-            cls = getattr(importlib.import_module(m.module), m.class_name)
+            mod = None
+            if engine_dir is not None:  # engine-dir module, scoped import
+                mod = _import_engine_scoped(engine_dir, m.module)
+            if mod is None:
+                # a library module, or (legacy/scoped) already registered
+                mod = sys.modules.get(m.module) or importlib.import_module(m.module)
+            cls = getattr(mod, m.class_name)
             models.append(cls.load(instance.id, algo.params, ctx))
         elif isinstance(m, RetrainMarker):
             assert retrained is not None

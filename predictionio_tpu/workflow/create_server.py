@@ -38,6 +38,7 @@ from aiohttp import web
 from ..controller.engine import Engine, TrainResult
 from ..controller.params import parse_params
 from ..storage import EngineInstance, Storage
+from .microbatch import ServerBusy
 from .context import Context
 from .core_workflow import prepare_deploy
 
@@ -100,10 +101,14 @@ class EngineServer:
         access_key: str | None = None,
         batch_window_ms: float = 1.0,
         batch_max: int = 64,
+        engine_dir=None,
     ):
         self.engine = engine
         self.ctx = ctx or Context(mode="Serving")
-        self.deployed = Deployed(instance, prepare_deploy(engine, instance, self.ctx))
+        self.engine_dir = engine_dir  # for re-resolving blob classes
+        self.deployed = Deployed(
+            instance,
+            prepare_deploy(engine, instance, self.ctx, engine_dir=engine_dir))
         self.feedback_url = feedback_url
         self.access_key = access_key
         self.start_time = datetime.now(timezone.utc)
@@ -111,6 +116,11 @@ class EngineServer:
         self.request_count = 0
         self.avg_serving_sec = 0.0
         self.last_serving_sec = 0.0
+        # serving stats are read-modify-written from the MicroBatcher
+        # worker and from asyncio.to_thread workers when batching is off —
+        # a lock keeps the running average exact (reference keeps these on
+        # a single actor, CreateServer.scala:552-559)
+        self._stats_lock = threading.Lock()
         self._reload_lock = threading.Lock()  # serialize expensive reloads
         # micro-batching dispatcher (workflow/microbatch.py): coalesce
         # concurrent queries into fixed-shape batched device calls;
@@ -198,9 +208,11 @@ class EngineServer:
                 outcomes.append(("err", e))
 
         dt = time.perf_counter() - t0
-        self.request_count += n
-        self.last_serving_sec = dt / n
-        self.avg_serving_sec += (dt / n - self.avg_serving_sec) * n / self.request_count
+        with self._stats_lock:
+            self.request_count += n
+            self.last_serving_sec = dt / n
+            self.avg_serving_sec += (
+                (dt / n - self.avg_serving_sec) * n / self.request_count)
         return outcomes
 
     # -- hot reload (MasterActor ReloadServer, :315-336) -------------------
@@ -216,7 +228,8 @@ class EngineServer:
         )
         if latest is None:
             raise RuntimeError("no COMPLETED engine instance to reload")
-        fresh = Deployed(latest, prepare_deploy(self.engine, latest, self.ctx))
+        fresh = Deployed(latest, prepare_deploy(self.engine, latest, self.ctx,
+                                                engine_dir=self.engine_dir))
         self.deployed = fresh  # atomic reference swap
         log.info("Reloaded engine instance %s", latest.id)
         return latest.id
@@ -278,6 +291,8 @@ async def handle_query(request: web.Request) -> web.Response:
             result = await server.batcher.submit(query_json)
         else:
             result = await asyncio.to_thread(server.serve_query, query_json)
+    except ServerBusy as e:
+        return web.json_response({"message": str(e)}, status=503)
     except Exception as e:  # noqa: BLE001 — surface as 400 like the reference
         log.exception("query failed")
         return web.json_response({"message": str(e)}, status=400)
@@ -289,8 +304,37 @@ async def handle_query(request: web.Request) -> web.Response:
     return web.json_response(result)
 
 
+def _status_html(s: dict) -> str:
+    """Minimal server-rendered status page — the analog of the reference's
+    Twirl index template (core/src/main/twirl/, served from
+    CreateServer.scala:433-460). Same data as the JSON status."""
+    import html as _html
+
+    rows = "".join(
+        f"<tr><th>{_html.escape(str(k))}</th>"
+        f"<td>{_html.escape(json.dumps(v) if isinstance(v, (dict, list)) else str(v))}</td></tr>"
+        for k, v in s.items()
+    )
+    return (
+        "<!DOCTYPE html><html><head><title>PredictionIO-TPU Engine Server"
+        "</title><style>body{font-family:sans-serif;margin:2em}"
+        "table{border-collapse:collapse}th,td{border:1px solid #ccc;"
+        "padding:.35em .7em;text-align:left}th{background:#f3f3f3}"
+        "code{background:#f7f7f7;padding:0 .3em}</style></head><body>"
+        "<h1>Engine server is running</h1>"
+        f"<table>{rows}</table>"
+        "<p>POST a query to <code>/queries.json</code>; "
+        "<a href='/reload'>reload</a> the latest trained instance.</p>"
+        "</body></html>"
+    )
+
+
 async def handle_status(request: web.Request) -> web.Response:
-    return web.json_response(request.app[SERVER_KEY].status())
+    s = request.app[SERVER_KEY].status()
+    accept = request.headers.get("Accept", "")
+    if "text/html" in accept and "application/json" not in accept.split(";")[0]:
+        return web.Response(text=_status_html(s), content_type="text/html")
+    return web.json_response(s)
 
 
 async def handle_reload(request: web.Request) -> web.Response:

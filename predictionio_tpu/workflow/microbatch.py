@@ -27,7 +27,14 @@ from typing import Any, Callable, Sequence
 
 log = logging.getLogger("predictionio_tpu.server")
 
-__all__ = ["MicroBatcher"]
+__all__ = ["MicroBatcher", "ServerBusy"]
+
+
+class ServerBusy(RuntimeError):
+    """Raised by submit() when the pending queue is at capacity — the
+    HTTP layer maps it to 503 so overload sheds load instead of queueing
+    without bound (the reference's per-query dispatch is implicitly
+    bounded by its thread pool)."""
 
 
 class MicroBatcher:
@@ -39,10 +46,12 @@ class MicroBatcher:
         *,
         max_batch: int = 64,
         window_s: float = 0.001,
+        max_pending: int = 1024,
     ):
         self.batch_fn = batch_fn
         self.max_batch = max(1, max_batch)
         self.window_s = max(0.0, window_s)
+        self.max_pending = max(1, max_pending)
         self._pending: list[tuple[Any, asyncio.Future]] = []
         self._wake: asyncio.Event | None = None
         self._task: asyncio.Task | None = None
@@ -58,7 +67,10 @@ class MicroBatcher:
 
     async def submit(self, query: Any) -> Any:
         """Enqueue one query; resolves to its result (or raises its own
-        error) when its batch completes."""
+        error) when its batch completes. Raises ServerBusy at capacity."""
+        if len(self._pending) >= self.max_pending:
+            raise ServerBusy(
+                f"micro-batch queue full ({self.max_pending} pending)")
         self._ensure_started()
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending.append((query, fut))

@@ -15,6 +15,7 @@ from __future__ import annotations
 import dataclasses
 import io
 import pickle
+import re
 from typing import Any, Sequence
 
 __all__ = [
@@ -56,11 +57,54 @@ def _to_host(tree: Any) -> Any:
     return jax.tree_util.tree_map(conv, tree)
 
 
+#: dir-scoped engine modules (workflow/core_workflow.py:
+#: _import_engine_scoped) carry a `_pio_engine_<dirhash>_` name prefix;
+#: blobs must never depend on it — the hash changes whenever the engine
+#: dir's absolute path does (another host, a moved project)
+_SCOPED_RE = re.compile(r"^_pio_engine_[0-9a-f]{10}_")
+
+
+def plain_module_name(name: str) -> str:
+    """Strip the dir-scoped prefix: stable across hosts/paths."""
+    return _SCOPED_RE.sub("", name)
+
+
+class _EngineScopedUnpickler(pickle.Unpickler):
+    """Unpickler that re-resolves engine-module classes against a given
+    engine dir. A blob may reference a module as the plain name (a pre-
+    scoping blob, or another host's process) or as a scoped name whose dir
+    hash no longer matches — both re-import from ``engine_dir``."""
+
+    def __init__(self, file, engine_dir=None):
+        super().__init__(file)
+        self._engine_dir = engine_dir
+
+    def find_class(self, module, name):
+        # engine-dir FIRST: a plain sibling-module name (e.g.
+        # 'data_source') would otherwise resolve by sys.path order and
+        # could bind another engine's same-named file when several
+        # engine dirs are loaded in one process
+        if self._engine_dir is not None:
+            try:
+                from .core_workflow import _import_engine_scoped
+
+                mod = _import_engine_scoped(
+                    self._engine_dir, plain_module_name(module))
+                if mod is not None:
+                    obj = mod
+                    for part in name.split("."):
+                        obj = getattr(obj, part)
+                    return obj
+            except Exception:
+                pass  # fall through to the normal resolution
+        return super().find_class(module, name)
+
+
 def serialize_models(models: Sequence[Any]) -> bytes:
     buf = io.BytesIO()
     pickle.dump([_to_host(m) for m in models], buf, protocol=pickle.HIGHEST_PROTOCOL)
     return buf.getvalue()
 
 
-def deserialize_models(blob: bytes) -> list[Any]:
-    return pickle.loads(blob)
+def deserialize_models(blob: bytes, *, engine_dir=None) -> list[Any]:
+    return _EngineScopedUnpickler(io.BytesIO(blob), engine_dir).load()

@@ -31,6 +31,12 @@ import pytest  # noqa: E402
 from predictionio_tpu.storage import Storage  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "multihost: spawns multiple jax.distributed CPU processes")
+
+
 @pytest.fixture(autouse=True)
 def clean_storage():
     """Fresh in-memory storage per test (the reference drops HBase

@@ -267,3 +267,50 @@ def test_model_sharded_odd_sizes(rng, mesh8):
         m_ms.user_factors, m_rep.user_factors, rtol=2e-4, atol=2e-5)
     np.testing.assert_allclose(
         m_ms.item_factors, m_rep.item_factors, rtol=2e-4, atol=2e-5)
+
+
+def test_geometric_tiers_and_zero_drop():
+    """Auto tiers: every entry kept (zero drop), padding bounded, and an
+    explicit tuple auto-extends past its last edge instead of dropping."""
+    from predictionio_tpu.ops.neighbors import build_degree_buckets, geometric_tiers
+
+    rng = np.random.default_rng(0)
+    # zipf-ish skew with a heavy head row
+    rows = np.concatenate([
+        np.zeros(5000, np.int64),  # one row with degree 5000
+        rng.integers(0, 200, 8000),
+    ])
+    cols = rng.integers(0, 300, len(rows)).astype(np.int32)
+    vals = np.ones(len(rows), np.float32)
+    bk = build_degree_buckets(rows, cols, vals, 200, tiers="auto")
+    assert sum(b.blocks.dropped for b in bk) == 0
+    kept = sum(int((b.blocks.vals != 0).sum()) for b in bk)
+    assert kept == len(rows)
+    padded = sum(b.blocks.ids.size for b in bk)
+    # slack term: the minimum block is 8 rows (sublane tiling), so a tier
+    # holding a single ultra-heavy row pads 8x its D — constant at scale
+    assert padded < 2.2 * len(rows) + 8 * 5008, f"padding too fat: {padded}"
+    # explicit tiers smaller than the max degree: extended, not dropped
+    bk2 = build_degree_buckets(rows, cols, vals, 200, tiers=(8, 64))
+    assert sum(b.blocks.dropped for b in bk2) == 0
+    t = geometric_tiers(5000)
+    assert all(e % 8 == 0 for e in t) and t[-1] == 5000 + (8 - 5000 % 8) % 8
+
+
+def test_zero_rating_mask_derivation(rng, mesh8):
+    """Genuine 0.0 ratings must survive the maskless layout (nudged to
+    epsilon, still counted as real entries)."""
+    nu, ni = 20, 15
+    n = 200
+    r = Ratings(
+        user_indices=rng.integers(0, nu, n).astype(np.int64),
+        item_indices=rng.integers(0, ni, n).astype(np.int64),
+        ratings=np.where(rng.random(n) < 0.3, 0.0,
+                         rng.random(n) * 4 + 1).astype(np.float32),
+        user_ids=BiMap({f"u{i}": i for i in range(nu)}),
+        item_ids=BiMap({f"i{i}": i for i in range(ni)}),
+    )
+    cfg = ALSConfig(rank=4, iterations=3, implicit_prefs=True)
+    model = train_als(r, cfg, mesh=mesh8)
+    assert np.isfinite(model.user_factors).all()
+    assert np.isfinite(model.item_factors).all()

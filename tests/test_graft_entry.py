@@ -36,9 +36,14 @@ def test_dryrun_multichip_from_one_device_platform():
     env = dict(os.environ)
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
     env["JAX_PLATFORMS"] = "cpu"
+    # force CPU from inside too: a sitecustomize may re-point JAX_PLATFORMS
+    # at a device platform at interpreter startup (the env var alone is not
+    # authoritative), and this test must not depend on that device's health
     code = (
-        "import sys; sys.path.insert(0, %r)\n"
+        "import sys, os; sys.path.insert(0, %r)\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
         "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
         "assert len(jax.devices()) == 1, jax.devices()\n"
         "import __graft_entry__\n"
         "__graft_entry__.dryrun_multichip(8)\n" % REPO

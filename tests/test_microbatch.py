@@ -189,3 +189,30 @@ class TestBatchedServing:
 
         (out,) = run(main())
         assert isinstance(out, asyncio.CancelledError)
+
+
+def test_queue_cap_rejects_overload():
+    """submit() raises ServerBusy past max_pending instead of queueing
+    without bound."""
+    import asyncio
+
+    from predictionio_tpu.workflow.microbatch import MicroBatcher, ServerBusy
+
+    async def run():
+        started = asyncio.Event()
+
+        def slow_batch(queries):
+            return [("ok", q) for q in queries]
+
+        mb = MicroBatcher(slow_batch, max_batch=2, window_s=5.0,
+                          max_pending=3)
+        tasks = [asyncio.create_task(mb.submit(i)) for i in range(3)]
+        await asyncio.sleep(0)  # let them enqueue inside the open window
+        with __import__("pytest").raises(ServerBusy):
+            await mb.submit(99)
+        await mb.close()
+        for t in tasks:
+            with __import__("pytest").raises(asyncio.CancelledError):
+                await t
+
+    asyncio.run(run())

@@ -1,0 +1,194 @@
+"""Two engines with same-named modules in ONE process.
+
+Round-1 weakness: `_engine_from_variant` permanently prepended the engine
+dir to sys.path and every template names its module `engine`, so training
+or deploying a second engine imported the FIRST engine's code. The
+dir-scoped loader (workflow/core_workflow.py:_import_engine_scoped) fixes
+that; these tests pin it.
+"""
+
+import json
+import shutil
+from pathlib import Path
+
+import pytest
+import requests
+
+from predictionio_tpu.storage import Storage
+from predictionio_tpu.tools.cli import main as pio
+from predictionio_tpu.workflow import resolve_engine_factory
+from tests.helpers import ServerThread
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _make_hello_engine(tmp_path, name: str, offset: float) -> Path:
+    """Copy the helloworld template and bake in a distinguishing offset
+    added to every prediction, so responses prove whose code ran."""
+    d = tmp_path / name
+    shutil.copytree(REPO / "templates" / "helloworld", d)
+    src = (d / "engine.py").read_text()
+    src = src.replace(
+        "return PredictedResult(temperature=model.get(query.day, 0.0))",
+        f"return PredictedResult(temperature=model.get(query.day, 0.0) + {offset})",
+    )
+    assert f"+ {offset}" in src, "template changed; update the marker patch"
+    (d / "engine.py").write_text(src)
+    variant = json.loads((d / "engine.json").read_text())
+    variant["id"] = name
+    variant["datasource"]["params"]["app_name"] = name
+    (d / "engine.json").write_text(json.dumps(variant))
+    return d
+
+
+def _import_events(app_name: str, tmp_path, temps) -> None:
+    assert pio(["app", "new", app_name]) == 0
+    app = Storage.get_metadata().app_get_by_name(app_name)
+    lines = [json.dumps({
+        "event": "read", "entityType": "sensor", "entityId": "s1",
+        "properties": {"day": "Mon", "temperature": t},
+        "eventTime": "2020-01-01T00:00:00Z",
+    }) for t in temps]
+    f = tmp_path / f"{app_name}.jsonl"
+    f.write_text("\n".join(lines))
+    assert pio(["import", "--appid", str(app.id), "--input", str(f)]) == 0
+
+
+def test_two_engines_train_and_serve_in_one_process(tmp_path):
+    d_a = _make_hello_engine(tmp_path, "multia", 100.0)
+    d_b = _make_hello_engine(tmp_path, "multib", 200.0)
+    _import_events("multia", tmp_path, [10.0, 20.0])  # avg 15
+    _import_events("multib", tmp_path, [30.0, 50.0])  # avg 40
+
+    # interleave: build+train A, then B — the second train must not pick
+    # up A's module
+    assert pio(["build", "--engine-dir", str(d_a)]) == 0
+    assert pio(["build", "--engine-dir", str(d_b)]) == 0
+    assert pio(["train", "--engine-dir", str(d_a)]) == 0
+    assert pio(["train", "--engine-dir", str(d_b)]) == 0
+
+    meta = Storage.get_metadata()
+    inst_a = meta.engine_instance_get_completed("multia", "1", "multia")[0]
+    inst_b = meta.engine_instance_get_completed("multib", "1", "multib")[0]
+
+    from predictionio_tpu.workflow.create_server import (
+        EngineServer,
+        create_engine_server_app,
+    )
+
+    eng_a = resolve_engine_factory("engine:engine_factory", engine_dir=d_a)
+    eng_b = resolve_engine_factory("engine:engine_factory", engine_dir=d_b)
+    assert (eng_a.algorithm_classes["average"]
+            is not eng_b.algorithm_classes["average"])
+
+    st_a = ServerThread(lambda: create_engine_server_app(
+        EngineServer(eng_a, inst_a)))
+    st_b = ServerThread(lambda: create_engine_server_app(
+        EngineServer(eng_b, inst_b)))
+    try:
+        r_a = requests.post(st_a.url + "/queries.json", json={"day": "Mon"})
+        r_b = requests.post(st_b.url + "/queries.json", json={"day": "Mon"})
+        assert r_a.status_code == 200 and r_b.status_code == 200
+        # engine A: avg 15 + offset 100; engine B: avg 40 + offset 200
+        assert r_a.json()["temperature"] == pytest.approx(115.0)
+        assert r_b.json()["temperature"] == pytest.approx(240.0)
+    finally:
+        st_a.stop()
+        st_b.stop()
+
+
+def test_scoped_import_isolated_and_cached(tmp_path):
+    d_a = _make_hello_engine(tmp_path, "cachea", 1.0)
+    d_b = _make_hello_engine(tmp_path, "cacheb", 2.0)
+    from predictionio_tpu.workflow.core_workflow import _import_engine_scoped
+
+    m_a = _import_engine_scoped(d_a, "engine")
+    m_b = _import_engine_scoped(d_b, "engine")
+    assert m_a is not m_b
+    assert m_a.__name__ != m_b.__name__
+    assert "." not in m_a.__name__  # flat name: pickle-round-trip safe
+    # second load of the same dir returns the cached module
+    assert _import_engine_scoped(d_a, "engine") is m_a
+    # a module the dir does not contain -> None (caller falls back)
+    assert _import_engine_scoped(d_a, "not_there") is None
+    # plain name never leaks into sys.modules
+    import sys
+
+    assert "engine" not in sys.modules or not str(
+        getattr(sys.modules["engine"], "__file__", "")).startswith(str(tmp_path))
+
+
+MOVED_ENGINE_SRC = '''
+"""Engine whose model class lives in the engine module — exercises
+pickle round-trips across a moved engine dir."""
+from collections import defaultdict
+from predictionio_tpu.controller import (Algorithm, DataSource, Engine,
+                                         FirstServing, IdentityPreparator)
+
+
+class MovedModel:
+    def __init__(self, averages):
+        self.averages = averages
+
+
+class DS(DataSource):
+    def read_training(self, ctx):
+        store = ctx.event_store()
+        return [(str(e.properties.get("day")),
+                 float(e.properties.get("temperature")))
+                for e in store.find(app_name="movedapp",
+                                    event_names=["read"])]
+
+
+class Algo(Algorithm):
+    def train(self, ctx, pd):
+        sums = defaultdict(list)
+        for day, temp in pd:
+            sums[day].append(temp)
+        return MovedModel({d: sum(v) / len(v) for d, v in sums.items()})
+
+    def predict(self, model, query):
+        return {"temperature": model.averages.get(query.get("day"), 0.0)}
+
+
+def engine_factory():
+    return Engine(
+        data_source_classes=DS,
+        preparator_classes=IdentityPreparator,
+        algorithm_classes={"a": Algo},
+        serving_classes=FirstServing,
+    )
+'''
+
+
+def test_model_blob_survives_moved_engine_dir(tmp_path):
+    """Model blobs pickled with engine-module classes must deploy after
+    the engine dir's absolute path changes (new host / moved project):
+    the dir-hash in scoped module names must not leak into blobs."""
+    import sys
+
+    from predictionio_tpu.workflow.core_workflow import prepare_deploy
+
+    d1 = tmp_path / "orig"
+    d1.mkdir()
+    (d1 / "engine.py").write_text(MOVED_ENGINE_SRC)
+    (d1 / "engine.json").write_text(json.dumps({
+        "id": "movedapp", "engineFactory": "engine:engine_factory",
+        "datasource": {"params": {}}, "algorithms": [{"name": "a", "params": {}}],
+    }))
+    _import_events("movedapp", tmp_path, [10.0, 30.0])  # avg 20
+    assert pio(["train", "--engine-dir", str(d1)]) == 0
+    inst = Storage.get_metadata().engine_instance_get_completed(
+        "movedapp", "1", "movedapp")[0]
+
+    # move the dir and simulate a fresh process: drop every scoped module
+    d2 = tmp_path / "relocated"
+    d1.rename(d2)
+    for name in [n for n in sys.modules if n.startswith("_pio_engine_")]:
+        del sys.modules[name]
+    sys.path[:] = [p for p in sys.path if p != str(d1)]
+
+    eng = resolve_engine_factory("engine:engine_factory", engine_dir=d2)
+    result = prepare_deploy(eng, inst, engine_dir=d2)
+    out = result.algorithms[0].predict(result.models[0], {"day": "Mon"})
+    assert out == {"temperature": 20.0}

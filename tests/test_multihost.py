@@ -1,0 +1,155 @@
+"""Two-process jax.distributed smoke test — the DCN control plane.
+
+The reference's driver<->executor control plane is Spark's akka RPC
+(reference: tools/src/main/scala/io/prediction/tools/Runner.scala:36-110
+spawning executors via spark-submit; CreateServer.scala actor system).
+Here the equivalent is the jax.distributed runtime: N processes join a
+coordinator, jax.devices() spans all of them, and collectives ride the
+global mesh. Round 1 wrapped this in ``parallel/mesh.py:init_distributed``
+but never exercised it end to end; this test spawns a real coordinator +
+worker process pair on the CPU backend and checks:
+
+- both processes see the union of devices (2 local x 2 procs = 4 global);
+- a jitted global-sum over a data-sharded global array (XLA inserts the
+  cross-process psum) gives the true total on BOTH processes;
+- ``find_frame(host_shard=(process_index, process_count))`` over a shared
+  sqlite event store hands each process a disjoint, complete entity slice
+  (the multi-host data-loading contract, storage/partition.py).
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+
+WORKER_SRC = r'''
+import json, os, sys
+
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+addr = sys.argv[3]
+db_path = sys.argv[4]
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, %(repo)r)
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.parallel.mesh import init_distributed, make_mesh
+
+init_distributed(coordinator_address=addr, num_processes=nproc, process_id=pid)
+assert jax.process_index() == pid
+assert jax.process_count() == nproc
+n_global = len(jax.devices())
+assert n_global == 2 * nproc, jax.devices()
+
+# --- global-mesh collective: data-sharded sum (psum over DCN) ----------
+mesh = make_mesh((n_global,), ("data",))
+sh = NamedSharding(mesh, P("data"))
+rows = 2 * n_global
+full = np.arange(rows, dtype=np.float32)
+local = full[pid * (rows // nproc):(pid + 1) * (rows // nproc)]
+arr = jax.make_array_from_process_local_data(sh, local)
+total = jax.jit(lambda x: x.sum(), out_shardings=NamedSharding(mesh, P()))(arr)
+total_host = float(np.asarray(total))
+
+# --- multi-host event slice over the SHARED store ----------------------
+from predictionio_tpu.storage import Storage
+Storage.reset()
+Storage.configure("METADATA", "sqlite", path=db_path + ".meta")
+Storage.configure("EVENTDATA", "sqlite", path=db_path)
+from predictionio_tpu.store.event_store import EventStore
+store = EventStore()
+frame = store.find_frame(app_name="mh", host_shard=(pid, nproc))
+entities = sorted(set(frame.entity_id))
+
+print("RESULT " + json.dumps({
+    "pid": pid, "process_count": jax.process_count(),
+    "global_devices": n_global, "total": total_host,
+    "entities": entities,
+}), flush=True)
+'''
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.multihost
+def test_two_process_distributed_psum_and_host_sharded_load(tmp_path):
+    # seed a shared sqlite event store with 40 entities of events
+    from predictionio_tpu.storage import Storage
+    from predictionio_tpu.storage.event import Event
+    from predictionio_tpu.storage.sqlite import SQLiteEvents
+    from datetime import datetime, timezone
+
+    db_path = str(tmp_path / "events.db")
+    # metadata must be shared too: workers resolve app_name -> app_id
+    Storage.configure("METADATA", "sqlite", path=db_path + ".meta")
+    app_id = Storage.get_metadata().app_insert("mh").id
+    be = SQLiteEvents({"path": db_path})
+    be.init_app(app_id)
+    t = datetime(2020, 1, 1, tzinfo=timezone.utc)
+    for i in range(40):
+        be.insert(Event(event="rate", entity_type="user",
+                        entity_id=f"u{i}", event_time=t,
+                        properties={"rating": 4.0}), app_id)
+    be.close()
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(WORKER_SRC % {"repo": str(REPO)})
+    addr = f"127.0.0.1:{_free_port()}"
+
+    env = dict(os.environ)
+    env.pop("PYTHONSTARTUP", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(pid), "2", addr, db_path],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=str(tmp_path),
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multihost worker timed out")
+        assert p.returncode == 0, err[-3000:]
+        outs.append(out)
+
+    results = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                r = json.loads(line[7:])
+                results[r["pid"]] = r
+    assert set(results) == {0, 1}, outs
+
+    rows = 2 * results[0]["global_devices"]
+    expected_total = sum(range(rows))
+    for r in results.values():
+        assert r["process_count"] == 2
+        assert r["global_devices"] == 4
+        assert r["total"] == expected_total  # psum crossed the processes
+
+    e0 = set(results[0]["entities"])
+    e1 = set(results[1]["entities"])
+    assert e0 and e1, "both hosts must get a non-empty slice"
+    assert not (e0 & e1), "host shards must be disjoint"
+    assert e0 | e1 == {f"u{i}" for i in range(40)}, "shards must cover all"

@@ -92,3 +92,54 @@ def test_admin_app_crud():
         assert r.status_code == 404
     finally:
         st.stop()
+
+
+def test_engine_server_html_status(tmp_path, rng):
+    """GET / with Accept: text/html renders the status page (reference
+    Twirl index, CreateServer.scala:433-460); default stays JSON."""
+    import json as _json
+    import shutil
+    from pathlib import Path
+
+    import requests
+
+    from predictionio_tpu.storage import Storage
+    from predictionio_tpu.tools.cli import main as pio
+    from predictionio_tpu.workflow import resolve_engine_factory
+    from predictionio_tpu.workflow.create_server import (
+        EngineServer,
+        create_engine_server_app,
+    )
+    from tests.helpers import ServerThread
+
+    repo = Path(__file__).resolve().parents[1]
+    d = tmp_path / "hello"
+    shutil.copytree(repo / "templates" / "helloworld", d)
+    variant = _json.loads((d / "engine.json").read_text())
+    variant["datasource"]["params"]["app_name"] = "htmlapp"
+    (d / "engine.json").write_text(_json.dumps(variant))
+
+    assert pio(["app", "new", "htmlapp"]) == 0
+    app = Storage.get_metadata().app_get_by_name("htmlapp")
+    f = tmp_path / "ev.jsonl"
+    f.write_text(_json.dumps({
+        "event": "read", "entityType": "sensor", "entityId": "s1",
+        "properties": {"day": "Mon", "temperature": 20.0},
+        "eventTime": "2020-01-01T00:00:00Z"}))
+    assert pio(["import", "--appid", str(app.id), "--input", str(f)]) == 0
+    assert pio(["train", "--engine-dir", str(d)]) == 0
+    inst = Storage.get_metadata().engine_instance_get_completed(
+        "default", "1", "default")[0]
+    eng = resolve_engine_factory("engine:engine_factory", engine_dir=d)
+    st = ServerThread(lambda: create_engine_server_app(EngineServer(eng, inst)))
+    try:
+        r = requests.get(st.url + "/", headers={"Accept": "text/html"})
+        assert r.status_code == 200
+        assert r.headers["Content-Type"].startswith("text/html")
+        assert "Engine server is running" in r.text
+        assert inst.id in r.text
+        r2 = requests.get(st.url + "/")
+        assert r2.headers["Content-Type"].startswith("application/json")
+        assert r2.json()["engineInstanceId"] == inst.id
+    finally:
+        st.stop()

@@ -137,10 +137,13 @@ class TestCustomQuerySerializer:
 
         algo = Algo()
         result = TrainResult([None], [algo], FirstServing(), ["a"])
+        import threading
+
         server = EngineServer.__new__(EngineServer)
         server.request_count = 0
         server.avg_serving_sec = 0.0
         server.last_serving_sec = 0.0
+        server._stats_lock = threading.Lock()
 
         class Bundle:
             pass

@@ -28,9 +28,6 @@ def engine_dir(tmp_path):
     variant["datasource"]["params"]["app_name"] = "qtest"
     (d / "engine.json").write_text(json.dumps(variant))
     yield d
-    sys.path[:] = [p for p in sys.path if p != str(d)]
-    for mod in ("engine",):
-        sys.modules.pop(mod, None)
 
 
 def make_events_file(path, rng, nu=30, ni=20):
@@ -89,8 +86,8 @@ def test_quickstart(engine_dir, tmp_path, rng, capsys):
     )
     from predictionio_tpu.workflow import resolve_engine_factory
 
-    sys.path.insert(0, str(engine_dir))
-    engine = resolve_engine_factory("engine:engine_factory")
+    engine = resolve_engine_factory("engine:engine_factory",
+                                    engine_dir=engine_dir)
     server = EngineServer(engine, insts[0])
     st = ServerThread(lambda: create_engine_server_app(server))
     try:
@@ -188,4 +185,3 @@ class MyGrid(EngineParamsGenerator):
     out = capsys.readouterr().out
     assert "leaderboard" in out
     assert (engine_dir / "best.json").exists()
-    sys.modules.pop("evaluation", None)
