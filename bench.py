@@ -50,8 +50,8 @@ def run_bench(n_ratings: int, iters: int, device_kind: str,
               compute_dtype: str = "float32") -> dict:
     import jax
 
-    from predictionio_tpu.models.als import _put_buckets, make_train_step
-    from predictionio_tpu.ops.neighbors import build_degree_buckets
+    from predictionio_tpu.models.als import make_train_step, put_layout
+    from predictionio_tpu.ops.neighbors import build_bilinear_layout
     from predictionio_tpu.parallel.mesh import make_mesh
 
     t0 = time.time()
@@ -59,29 +59,29 @@ def run_bench(n_ratings: int, iters: int, device_kind: str,
     log(f"[{device_kind}] data gen ({n_ratings} ratings): {time.time()-t0:.1f}s")
 
     t0 = time.time()
-    u_buckets = build_degree_buckets(users, items, vals, NU)
-    i_buckets = build_degree_buckets(items, users, vals, NI)
-    dropped = sum(b.blocks.dropped for b in u_buckets + i_buckets)
+    u_lay, i_lay = build_bilinear_layout(users, items, vals, NU, NI)
     log(
         f"[{device_kind}] layout: {time.time()-t0:.1f}s; "
-        f"user tiers {[b.blocks.ids.shape for b in u_buckets]}, "
-        f"item tiers {[b.blocks.ids.shape for b in i_buckets]}, dropped {dropped}"
+        f"user tiers {[b.ids.shape for b in u_lay.buckets]}, "
+        f"item tiers {[b.ids.shape for b in i_lay.buckets]}, "
+        f"dropped {u_lay.dropped + i_lay.dropped}"
     )
 
     mesh = make_mesh()
     from jax.sharding import NamedSharding, PartitionSpec as P
 
+    vals_dtype = "bfloat16" if compute_dtype == "bfloat16" else None
     t0 = time.time()
-    u_bk = _put_buckets(u_buckets, mesh)
-    i_bk = _put_buckets(i_buckets, mesh)
+    u_bk = put_layout(u_lay, mesh, vals_dtype=vals_dtype)
+    i_bk = put_layout(i_lay, mesh, vals_dtype=vals_dtype)
     rng = np.random.default_rng(1)
-    v = jax.device_put(
-        np.abs(rng.normal(size=(NI, RANK))).astype(np.float32) / np.sqrt(RANK),
-        NamedSharding(mesh, P()),
-    )
+    v_host = np.zeros((i_lay.slots, RANK), np.float32)
+    v_host[i_lay.pos] = (np.abs(rng.normal(size=(NI, RANK))).astype(np.float32)
+                         / np.sqrt(RANK))
+    v = jax.device_put(v_host, NamedSharding(mesh, P()))
     log(f"[{device_kind}] device_put: {time.time()-t0:.1f}s on {jax.devices()[0].platform}")
 
-    step = make_train_step(mesh, rank=RANK, lambda_=0.1, nu=NU, ni=NI,
+    step = make_train_step(mesh, u_lay, i_lay, rank=RANK, lambda_=0.1,
                            compute_dtype=compute_dtype)
     log(f"[{device_kind}] compute_dtype={compute_dtype}")
 
@@ -111,7 +111,7 @@ def run_bench(n_ratings: int, iters: int, device_kind: str,
     assert np.isfinite(final).all()
     log(f"[{device_kind}] {iters} iters in {dt:.2f}s -> {iters/dt:.3f} iters/sec")
     return {"iters_per_sec": iters / dt, "n_ratings": n_ratings,
-            "u": np.asarray(u), "v": np.asarray(v)}
+            "u": np.asarray(u)[u_lay.pos], "v": np.asarray(v)[i_lay.pos]}
 
 
 def predict_latency(u: np.ndarray, v: np.ndarray, n_queries: int = 100) -> dict:

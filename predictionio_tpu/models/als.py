@@ -9,17 +9,23 @@ src/main/scala/ALSAlgorithm.scala:130).
 
 Design (ALX-style, arxiv 2112.02194 — see PAPERS.md):
 
-- Ratings live as padded fixed-shape neighbor blocks (ops/neighbors.py);
-  no shuffles — layout is computed once and stays in HBM.
+- Ratings live as padded fixed-shape neighbor blocks in a PERMUTED
+  two-sided layout (ops/neighbors.py build_bilinear_layout); no shuffles
+  — layout is computed once and stays in HBM for every iteration.
 - One half-step solves all users (then all items) with batched normal
-  equations: A_u = Σ_j v_j v_jᵀ (+ λ·n_u·I), b_u = Σ_j r_uj v_j, solved by
-  a vmapped dense ``jnp.linalg.solve`` — MXU-friendly [D,R]ᵀ[D,R] einsums.
-- ``lax.map`` over row blocks bounds peak memory (a block's gathered
-  factors are [B, D, R]); rows within a block shard over the mesh's
-  ``data`` axis, the opposite factor matrix is replicated, so the only
-  collective XLA inserts is the all-gather of the freshly-updated factors
-  between half-steps — that is the ICI traffic, replacing MLlib's
-  factor-block shuffle.
+  equations A_u = Σ_j v_j v_jᵀ (+ λ·n_u·I), b_u = Σ_j r_uj v_j, per
+  degree tier: gramian einsums (lax.map over row blocks bounds peak
+  memory), then a Jacobi-preconditioned batched CG whose matvec rides
+  the VPU (see _spd_solve). Tier outputs CONCATENATE into the permuted
+  factor array — the step contains zero scatters (measured ~3-12M
+  rows/s on v5e vs ~470M rows/s for gathers).
+- Rows heavier than ``chunk_cap`` ride a dedicated tier as balanced
+  chunks whose partial equations segment-sum per owner row.
+- Rows within a block shard over the mesh's ``data`` axis; the opposite
+  factor matrix is replicated (or row-sharded over ``model`` with
+  ``model_sharded``), so the only collective XLA inserts is the
+  all-gather of freshly-updated factors between half-steps — that is the
+  ICI traffic, replacing MLlib's factor-block shuffle.
 - Implicit feedback (Hu-Koren-Volinsky): per-entry confidence
   c = 1 + alpha·r with the VᵀV gramian trick; gramian is one einsum
   (psum'd over shards by XLA when V is sharded).
@@ -37,7 +43,7 @@ from typing import Any
 
 import numpy as np
 
-from ..ops.neighbors import DegreeBucket, build_degree_buckets
+from ..ops.neighbors import build_bilinear_layout
 from ..ops.retrieval import RetrievalServingMixin
 from ..storage.bimap import BiMap
 from ..storage.frame import Ratings
@@ -48,8 +54,22 @@ __all__ = ["ALSModel", "ALSConfig", "train_als"]
 
 #: single source of truth for the CG inner-solver depth — ALSConfig, the
 #: bench, and direct make_train_step/_half_step callers must agree, or an
-#: accuracy gate could validate a different config than the timed one
-DEFAULT_CG_ITERS = 32
+#: accuracy gate could validate a different config than the timed one.
+#: 8 Jacobi-preconditioned iterations replace the old 32 plain-CG ones:
+#: CG re-reads the [N, R, R] gramians every iteration, a dominant HBM
+#: term of a training step, so depth is the single biggest solver knob —
+#: diagonal preconditioning buys the depth back (solver-parity tests and
+#: the bench accuracy gate pin end-model quality). Implicit mode's
+#: normal equations (dense VᵀV + plain-λ ridge) are worse conditioned
+#: AND less diagonal — Jacobi helps less — so it runs deeper.
+DEFAULT_CG_ITERS = 8
+DEFAULT_CG_ITERS_IMPLICIT = 16
+
+
+def _resolve_cg_iters(cg_iters, implicit: bool) -> int:
+    if cg_iters is not None:
+        return cg_iters
+    return DEFAULT_CG_ITERS_IMPLICIT if implicit else DEFAULT_CG_ITERS
 
 
 @dataclasses.dataclass(frozen=True)
@@ -66,6 +86,10 @@ class ALSConfig:
     tiers: tuple | str = "auto"
     #: per-block gather budget in elements (B*D cap) — bounds peak memory
     gather_budget: int = 2_000_000
+    #: rows heavier than this split into balanced chunks riding a
+    #: dedicated tier, partial normal equations segment-summed per owner
+    #: (ops/neighbors.py build_bilinear_layout)
+    chunk_cap: int = 2048
     #: "bfloat16" halves the HBM traffic of the factor gather and runs the
     #: gramian einsums at MXU bf16 rate (f32 accumulation; the normal-
     #: equation solve stays f32). "float32" is bit-stable default.
@@ -79,8 +103,9 @@ class ALSConfig:
     #: on conditioning, which is below the movement of an ALS sweep, and
     #: the alternation self-corrects across iterations — final model
     #: quality matches the exact solvers (see test_als solver parity).
+    #: None = auto (DEFAULT_CG_ITERS explicit / _IMPLICIT implicit).
     #: Raise for small-λ / ill-conditioned setups, or set solver="cholesky".
-    cg_iters: int = DEFAULT_CG_ITERS
+    cg_iters: int | None = None
     #: shard the factor matrices' rows over the mesh's ``model`` axis
     #: (tensor-parallel factors, ALX-style). Requires a mesh with a
     #: ``model`` axis; silently equivalent to replicated when that axis
@@ -172,8 +197,16 @@ def _run_fingerprint(ratings: Ratings, config: ALSConfig) -> int:
 # ---------------------------------------------------------------------------
 
 def _spd_solve(a, b, *, solver="cg", cg_iters=DEFAULT_CG_ITERS,
-               matvec_dtype=None):
-    """Batched SPD solve, [B, R, R] x [B, R].
+               matvec_dtype=None, shift=None, gram=None, diag=None):
+    """Batched SPD solve of (a + diag(shift) + gram) x = b, [B, R, R] x [B, R].
+
+    ``a`` arrives UNREGULARIZED (and possibly bf16); the ridge lives in
+    ``shift`` ([B] or scalar, the ALS-WR λ·n_u term) and ``gram`` ([R, R],
+    the implicit-mode VᵀV term), applied EXACTLY in f32 inside the
+    matvec — ap += shift·p (+ p@gram) — so quantizing a to bf16 never
+    touches the conditioning-critical ridge. ``diag`` optionally supplies
+    a's f32 diagonal (the gramian kernel emits it for free; extracting it
+    from a afterwards is a strided read of the whole array).
 
     "cg": fixed-iteration conjugate gradient — every step is a batched
     matvec/axpy, fully vectorized on TPU. Measured ~10x faster than
@@ -185,6 +218,13 @@ def _spd_solve(a, b, *, solver="cg", cg_iters=DEFAULT_CG_ITERS,
     next half-step corrects), not as a general linear solver.
     "cholesky"/"lu": exact factorizations (cholesky ≈ 2x LU).
 
+    The CG path is JACOBI-PRECONDITIONED: z = r / diag(A). The ridge-set
+    gramians' diagonals span the degree skew (λ·n_u ranges over 4 decades
+    on zipf data), which is exactly the variation a diagonal scaling
+    removes — measured, 10 preconditioned iterations match 32 plain ones
+    on the solver-parity suite, a 3.2x cut of CG's gramian re-read
+    traffic (the dominant HBM term of a training step).
+
     ``matvec_dtype=bfloat16`` runs the A·p matvec with a bf16 copy of A
     (f32 accumulation, f32 residual/search-vector updates): CG is HBM-
     bound on re-reading the [B, R, R] gramians every iteration, so this
@@ -195,142 +235,299 @@ def _spd_solve(a, b, *, solver="cg", cg_iters=DEFAULT_CG_ITERS,
     import jax
     import jax.numpy as jnp
 
-    if solver == "lu":
-        return jnp.linalg.solve(a, b[..., None]).squeeze(-1)
-    if solver == "cholesky":
-        chol = jnp.linalg.cholesky(a)  # [B, R, R] lower
+    f32 = jnp.float32
+    rank = a.shape[-1]
+    if shift is None:
+        shift_b = jnp.zeros((), f32)
+    else:
+        shift_b = jnp.asarray(shift, f32)
+        if shift_b.ndim == 1:
+            shift_b = shift_b[:, None]  # [B, 1] broadcasting over R
+
+    if solver in ("lu", "cholesky"):
+        a_full = a.astype(f32)
+        eye = jnp.eye(rank, dtype=f32)
+        a_full = a_full + (shift_b[..., None] if shift_b.ndim else shift_b) * eye
+        if gram is not None:
+            a_full = a_full + gram.astype(f32)[None]
+        if solver == "lu":
+            return jnp.linalg.solve(a_full, b[..., None]).squeeze(-1)
+        chol = jnp.linalg.cholesky(a_full)  # [B, R, R] lower
         y = jax.lax.linalg.triangular_solve(
             chol, b[..., None], left_side=True, lower=True)
         x = jax.lax.linalg.triangular_solve(
             chol, y, left_side=True, lower=True, transpose_a=True)
         return x.squeeze(-1)
 
-    f32 = jnp.float32
     mdt = jnp.dtype(matvec_dtype) if matvec_dtype is not None else a.dtype
     a_m = a.astype(mdt)
+    gram_f = gram.astype(f32) if gram is not None else None
+    if diag is None:
+        diag = jnp.diagonal(a, axis1=-2, axis2=-1).astype(f32)
+    diag_eff = diag + shift_b
+    if gram_f is not None:
+        diag_eff = diag_eff + jnp.diagonal(gram_f)[None]
+    # Jacobi preconditioner (SPD ⇒ diag > 0; the floor only guards
+    # all-padding rows whose system is exactly 0·x = 0)
+    dinv = 1.0 / jnp.maximum(diag_eff, 1e-30)
+
+    def matvec(p):
+        # matvec as broadcast-multiply + lane reduction, NOT einsum: a
+        # batched [R, R] x [R] matvec is an N=1 matmul the MXU executes at
+        # ~3x the wall time of the VPU doing the same reads (measured on
+        # v5e; the op is HBM-bound on re-reading a_m either way)
+        ap = (a_m.astype(f32) * p[:, None, :]).sum(-1)
+        ap = ap + shift_b * p
+        if gram_f is not None:
+            ap = ap + p @ gram_f  # [B, R] x [R, R]: MXU-sized matmul
+        return ap
 
     def body(_, carry):
-        x, r, p, rs = carry
-        ap = jnp.einsum("brs,bs->br", a_m, p.astype(mdt),
-                        preferred_element_type=f32)
-        alpha = rs / jnp.maximum(jnp.einsum("br,br->b", p, ap), 1e-30)
+        x, r, p, rz = carry
+        ap = matvec(p)
+        alpha = rz / jnp.maximum(jnp.einsum("br,br->b", p, ap), 1e-30)
         x = x + alpha[:, None] * p
         r = r - alpha[:, None] * ap
-        rs_new = jnp.einsum("br,br->b", r, r)
-        p = r + (rs_new / jnp.maximum(rs, 1e-30))[:, None] * p
-        return x, r, p, rs_new
+        z = r * dinv
+        rz_new = jnp.einsum("br,br->b", r, z)
+        p = z + (rz_new / jnp.maximum(rz, 1e-30))[:, None] * p
+        return x, r, p, rz_new
 
     x0 = jnp.zeros_like(b)
-    rs0 = jnp.einsum("br,br->b", b, b)
-    x, *_ = jax.lax.fori_loop(0, cg_iters, body, (x0, b, b, rs0))
+    z0 = b * dinv
+    rz0 = jnp.einsum("br,br->b", b, z0)
+    x, *_ = jax.lax.fori_loop(0, cg_iters, body, (x0, b, z0, rz0))
     return x
 
 
-def _half_step(ids, vals, other, *, lambda_, implicit, alpha, rank,
-               compute_dtype="float32", solver="cg", cg_iters=DEFAULT_CG_ITERS):
-    """Solve all rows of one side given the other side's factors.
+def _gram_blocks(ids, vals, other_c, *, implicit, alpha, rank, masked=False,
+                 out_dtype=None, with_diag=False):
+    """Partial normal equations for every block row, NO regularization.
 
-    ids/vals: [NB, B, D]; other: [NO, R] (replicated).
-    Returns [NB, B, R] float32.
+    ids/vals: [NB, B, D]; other_c: [NO, R] already in compute dtype.
+    Returns (a [NB, B, R, R] out_dtype (default f32), b [NB, B, R] f32,
+    n [NB, B] f32[, d [NB, B, R] f32 — a's f32 diagonal, when
+    ``with_diag``]). The cast and diagonal ride INSIDE the lax.map body:
+    materializing f32 gramians and extracting the diagonal afterwards
+    costs three extra HBM passes over the step's largest array (measured
+    ~58ms/iter on the ML-20M user side).
+
+    a/b are this block row's *contribution*: a chunked heavy row's pieces
+    are segment-summed per owner by the caller (ops/neighbors.py
+    chunk_cap), so Σ chunks reproduces the whole-row equations exactly.
+    n counts valid entries (the ALS-WR λ·n_u term needs the total).
 
     Validity derives from ``vals != 0``: the layout (ops/neighbors.py)
     zeroes padded slots and nudges genuine zero ratings to 1e-30, so no
-    separate mask array rides along — that array was a third of the
-    layout's HBM traffic and host->device transfer at 20M-rating scale.
+    separate mask array rides along. With ``masked=False`` (the permuted
+    layout) padded ids point at a guaranteed-zero factor slot, so even
+    the [B, D, R]-shaped mask MULTIPLY disappears — that multiply is a
+    second full pass over the gathered factors that XLA cannot fuse into
+    the gramian matmul's operand, ~40% of the phase's HBM traffic.
+    ``masked=True`` is the standalone-blocks path (pad ids point at row
+    0, a real row, so gathered garbage must be zeroed).
 
-    ``compute_dtype="bfloat16"`` casts the gathered factors and weights to
-    bf16 (half the HBM bytes on the gather — the bandwidth-bound part) and
-    runs the einsums with f32 accumulation; the solve's vector updates
-    stay f32 (its matvec rides bf16 too, see _spd_solve).
+    With a bf16 ``other_c`` the [B, D, R] factor gather (the bandwidth-
+    bound part) moves half the bytes; einsums accumulate in f32.
     """
     import jax
     import jax.numpy as jnp
 
-    cdt = jnp.bfloat16 if compute_dtype == "bfloat16" else jnp.float32
+    cdt = other_c.dtype
     f32 = jnp.float32
+    odt = out_dtype or f32
     eye = jnp.eye(rank, dtype=f32)
-    other_c = other.astype(cdt)
-    gram = None
-    if implicit:
-        gram = jnp.einsum("dr,ds->rs", other_c, other_c,
-                          preferred_element_type=f32)  # [R, R] — the VᵀV trick
 
-    def solve_block(blk):
+    def gram_block(blk):
         b_ids, b_vals = blk
         valid = b_vals != 0  # [B, D] — padded slots are exactly 0
         f = other_c[b_ids]  # [B, D, R] gather — bf16 halves this traffic
-        f = f * valid.astype(cdt)[..., None]
+        if masked:
+            f = f * valid.astype(cdt)[..., None]
         vals_f32 = b_vals.astype(f32)
+        n = jnp.sum(valid, axis=1).astype(f32)
         if implicit:
-            # confidence c = 1 + alpha*r; (c-1) is 0 at padded slots already
+            # confidence c = 1 + alpha*r; (c-1) is 0 at padded slots
+            # already. The global VᵀV term is added ONCE per owner row by
+            # the solver's `gram` shift, not per chunk.
             cw = (alpha * vals_f32).astype(cdt)
-            a = gram[None] + jnp.einsum("bd,bdr,bds->brs", cw, f, f,
-                                        preferred_element_type=f32)
-            a = a + lambda_ * eye[None]
+            a = jnp.einsum("bd,bdr,bds->brs", cw, f, f,
+                           preferred_element_type=f32)
             b = jnp.einsum("bd,bdr->br",
                            ((1.0 + alpha * vals_f32)
                             * valid.astype(f32)).astype(cdt), f,
                            preferred_element_type=f32)
         else:
             a = jnp.einsum("bdr,bds->brs", f, f, preferred_element_type=f32)
-            n_u = jnp.sum(valid, axis=1).astype(f32)  # ALS-WR: λ·n_u·I
-            a = a + (lambda_ * jnp.maximum(n_u, 1.0))[:, None, None] * eye[None]
             b = jnp.einsum("bd,bdr->br", b_vals.astype(cdt), f,
                            preferred_element_type=f32)
-        return _spd_solve(a, b, solver=solver, cg_iters=cg_iters,
-                          matvec_dtype=cdt)
+        out = (a.astype(odt), b, n)
+        if with_diag:
+            out = out + ((a * eye[None]).sum(-1),)
+        return out
 
-    return jax.lax.map(solve_block, (ids, vals))
+    return jax.lax.map(gram_block, (ids, vals))
 
 
-def _put_buckets(buckets, mesh, *, vals_dtype=None):
-    """Device-put one side's buckets: neighbor blocks sharded over the data
-    axis, scatter indices replicated. No mask upload — validity is encoded
-    in vals (see _half_step). ``vals_dtype=bfloat16`` halves the ratings'
-    transfer + HBM footprint (exact for half-star ratings; otherwise a
-    rounding the bf16 compute path would apply anyway)."""
+# NOTE on a road not taken: a fused Pallas gramian kernel (per-row
+# [D,R]ᵀ[D,R] dots over the gathered factors) was prototyped and measured
+# SLOWER than XLA's batched einsum on v5e (16.5ms vs 7.5ms per
+# [8192,176,64] block — Mosaic serializes the per-row MXU dots, and
+# dot_general with batch dims hits a lowering bug in this jaxlib), and
+# Mosaic's dynamic-gather lowering cannot express the [NO,R] row gather
+# at all. The einsum path below IS the fast path; the step's floor is the
+# XLA gather itself, which reads a full (8,128) tile per gathered row.
+
+
+def _ridge(other_c, n, *, lambda_, implicit):
+    """(shift, gram) regularization pair for _spd_solve: ALS-WR
+    λ·max(n,1) diagonal shift in explicit mode; the Hu-Koren-Volinsky
+    VᵀV gramian + plain-λ shift in implicit mode."""
+    import jax.numpy as jnp
+
+    if implicit:
+        gram = jnp.einsum("dr,ds->rs", other_c, other_c,
+                          preferred_element_type=jnp.float32)  # VᵀV
+        return lambda_, gram
+    return lambda_ * jnp.maximum(n, 1.0), None
+
+
+def _half_step(ids, vals, other, *, lambda_, implicit, alpha, rank,
+               compute_dtype="float32", solver="cg", cg_iters=None):
+    """Solve all rows of one (un-chunked) block layout given the other
+    side's factors — the self-contained single-shot path (graft entry,
+    direct callers). ids/vals: [NB, B, D]; other: [NO, R] (replicated).
+    Returns [NB, B, R] float32. The production training path goes through
+    ``_solve_side`` instead, which accumulates gramians across buckets
+    before one global solve."""
+    import jax.numpy as jnp
+
+    cdt = jnp.bfloat16 if compute_dtype == "bfloat16" else jnp.float32
+    other_c = other.astype(cdt)
+    cg_iters = _resolve_cg_iters(cg_iters, implicit)
+    a, b, n = _gram_blocks(ids, vals, other_c, implicit=implicit,
+                           alpha=alpha, rank=rank, masked=True)
+    nb, blk = ids.shape[:2]
+    shift, gram = _ridge(other_c, n.reshape(-1), lambda_=lambda_,
+                         implicit=implicit)
+    x = _spd_solve(a.reshape(nb * blk, rank, rank), b.reshape(nb * blk, rank),
+                   solver=solver, cg_iters=cg_iters, matvec_dtype=cdt,
+                   shift=shift, gram=gram)
+    return x.reshape(nb, blk, rank)
+
+
+def put_layout(layout, mesh, *, vals_dtype=None):
+    """Device-put one side of the permuted layout: neighbor blocks sharded
+    over the data axis, chunk segment ids replicated. No mask upload —
+    validity is encoded in vals, and padded ids point at the other side's
+    zero slot (ops/neighbors.py). ``vals_dtype=bfloat16`` halves the
+    ratings' transfer + HBM footprint (exact for half-star ratings;
+    otherwise a rounding the bf16 compute path would apply anyway)."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     blk = NamedSharding(mesh, P(None, "data", None))
     rep = NamedSharding(mesh, P())
     out = []
-    for b in buckets:
-        vals = b.blocks.vals
+    for b, m in zip(layout.buckets, layout.metas):
+        vals = b.vals
         if vals_dtype is not None:
             import ml_dtypes
 
             dt = ml_dtypes.bfloat16 if vals_dtype == "bfloat16" else vals_dtype
             vals = vals.astype(dt)
-        out.append({
-            "ids": jax.device_put(b.blocks.ids, blk),
-            "vals": jax.device_put(vals, blk),
-            "rows": jax.device_put(b.row_ids, rep),
-        })
+        e = {"ids": jax.device_put(b.ids, blk),
+             "vals": jax.device_put(vals, blk)}
+        if m.seg is not None:
+            e["seg"] = jax.device_put(m.seg, rep)
+        out.append(e)
     return out
 
 
-def _solve_side(buckets, other, out_rows, *, kw):
-    """Solve every bucket of one side and scatter results into a fresh
-    [out_rows, rank] factor matrix (padding rows dropped by the scatter)."""
+def _solve_side(buckets, layout, other, *, kw):
+    """One side's full half-step over the permuted layout:
+
+    per tier, ``_gram_blocks`` computes each block row's partial normal
+    equations (lax.map bounds peak memory) and the chunked tier segment-
+    sums its pieces per owner; regularization and the compute-dtype cast
+    fuse into each tier's einsum epilogue (the solver never touches an
+    f32 gramian — at bf16 that halves CG's dominant re-read traffic);
+    then the tiers' equations CONCATENATE and ONE batched PCG solves the
+    whole side, emitting factors already in permuted order — the step
+    contains no scatter at all (a TPU scatter runs at ~3-12M rows/s; the
+    concats are contiguous writes). Degree-0 rows and padding slots are
+    the all-zero tail the layout reserves.
+
+    ``buckets`` are the device dicts from ``put_layout``; ``layout`` the
+    host ``SideLayout`` (static spans/segments metadata)."""
+    import jax
     import jax.numpy as jnp
 
-    rank = kw["rank"]
-    new = jnp.zeros((out_rows, rank), dtype=jnp.float32)
-    for b in buckets:
-        solved = _half_step(b["ids"], b["vals"], other, **kw)
-        flat = solved.reshape(-1, rank)
-        new = new.at[b["rows"]].set(flat, mode="drop")
-    return new
+    rank, implicit = kw["rank"], kw["implicit"]
+    # the bf16 gramian quantization only pays for CG (halves its HBM
+    # re-reads); the exact factorizations are chosen for precision, so
+    # they always get f32 equations
+    cdt = (jnp.bfloat16 if kw.get("compute_dtype") == "bfloat16"
+           and kw.get("solver") == "cg" else jnp.float32)
+    other_c = other.astype(cdt)
+    f32 = jnp.float32
+    pas, pbs, pns, pds = [], [], [], []
+    covered = 0
+    for b, m in zip(buckets, layout.metas):
+        chunked = m.seg is not None
+        if chunked:
+            # partial gramians stay f32 through the per-owner sums so the
+            # chunk accumulation doesn't round at bf16
+            pa, pb, pn = _gram_blocks(b["ids"], b["vals"], other_c,
+                                      implicit=implicit, alpha=kw["alpha"],
+                                      rank=rank)
+            seg = b["seg"]
+            pa = jax.ops.segment_sum(pa.reshape(-1, rank, rank), seg,
+                                     num_segments=m.span,
+                                     indices_are_sorted=True)
+            pb = jax.ops.segment_sum(pb.reshape(-1, rank), seg,
+                                     num_segments=m.span,
+                                     indices_are_sorted=True)
+            pn = jax.ops.segment_sum(pn.reshape(-1), seg,
+                                     num_segments=m.span,
+                                     indices_are_sorted=True)
+            pd = jnp.diagonal(pa, axis1=-2, axis2=-1).astype(f32)
+            pa = pa.astype(cdt)
+        else:
+            pa, pb, pn, pd = _gram_blocks(b["ids"], b["vals"], other_c,
+                                          implicit=implicit, alpha=kw["alpha"],
+                                          rank=rank, out_dtype=cdt,
+                                          with_diag=True)
+            pa = pa.reshape(-1, rank, rank)
+            pb = pb.reshape(-1, rank)
+            pn = pn.reshape(-1)
+            pd = pd.reshape(-1, rank)
+        pas.append(pa)
+        pbs.append(pb)
+        pns.append(pn)
+        pds.append(pd)
+        covered += m.span
+    cat = lambda xs: jnp.concatenate(xs) if len(xs) > 1 else xs[0]  # noqa: E731
+    a, bvec, n, d = cat(pas), cat(pbs), cat(pns), cat(pds)
+    shift, gram = _ridge(other_c, n, lambda_=kw["lambda_"],
+                         implicit=implicit)
+    x = _spd_solve(a, bvec, solver=kw["solver"], cg_iters=kw["cg_iters"],
+                   matvec_dtype=cdt, shift=shift, gram=gram, diag=d)
+    tail = layout.slots - covered
+    if tail:
+        x = jnp.concatenate([x, jnp.zeros((tail, rank), f32)])
+    return x
 
 
-def make_train_step(mesh, *, rank, lambda_=0.1, implicit=False, alpha=1.0,
-                    nu=None, ni=None, model_sharded: bool = False,
+def make_train_step(mesh, u_layout, i_layout, *, rank, lambda_=0.1,
+                    implicit=False, alpha=1.0, model_sharded: bool = False,
                     compute_dtype: str = "float32", solver: str = "cg",
-                    cg_iters: int = DEFAULT_CG_ITERS):
-    """One full ALS iteration (user half-step + item half-step) over
-    bucketed layouts as a single jitted function — the program the
-    multi-chip dry-run compiles, and the inner loop of ``train_als``.
+                    cg_iters: int | None = None):
+    """One full ALS iteration (user half-step + item half-step) over the
+    permuted two-sided layout as a single jitted function — the program
+    the multi-chip dry-run compiles, and the inner loop of ``train_als``.
+    ``step(u_buckets, i_buckets, v_perm) -> (u_perm, v_perm)`` operates
+    entirely in permuted slot space ([slots_u, R] / [slots_i, R]).
 
     ``model_sharded=True`` shards the factor matrices' rows over the mesh's
     ``model`` axis (tensor-parallel factors, ALX-style); XLA inserts the
@@ -340,13 +537,16 @@ def make_train_step(mesh, *, rank, lambda_=0.1, implicit=False, alpha=1.0,
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    fac = NamedSharding(mesh, P("model" if model_sharded else None, None))
+    row_ax = "model" if model_sharded else None
+    fac = NamedSharding(mesh, P(row_ax, None))
     kw = dict(lambda_=lambda_, implicit=implicit, alpha=alpha, rank=rank,
-              compute_dtype=compute_dtype, solver=solver, cg_iters=cg_iters)
+              compute_dtype=compute_dtype, solver=solver,
+              cg_iters=_resolve_cg_iters(cg_iters, implicit))
 
     def step(u_buckets, i_buckets, v):
-        u = _solve_side(u_buckets, v, nu, kw=kw)
-        v_new = _solve_side(i_buckets, u, ni, kw=kw)
+        u = _solve_side(u_buckets, u_layout, v, kw=kw)
+        u = jax.lax.with_sharding_constraint(u, fac)
+        v_new = _solve_side(i_buckets, i_layout, u, kw=kw)
         return u, v_new
 
     return jax.jit(step, out_shardings=(fac, fac), donate_argnums=(2,))
@@ -374,18 +574,6 @@ def train_als(ratings: Ratings, config: ALSConfig, mesh=None, *,
         raise ValueError("empty ratings: no users or items")
     rank = config.rank
 
-    user_buckets = build_degree_buckets(
-        ratings.user_indices, ratings.item_indices, ratings.ratings, nu,
-        tiers=config.tiers, gather_budget=config.gather_budget, seed=config.seed,
-    )
-    item_buckets = build_degree_buckets(
-        ratings.item_indices, ratings.user_indices, ratings.ratings, ni,
-        tiers=config.tiers, gather_budget=config.gather_budget, seed=config.seed,
-    )
-    dropped = sum(b.blocks.dropped for b in user_buckets + item_buckets)
-    if dropped:
-        log.info("degree tiers dropped %d entries beyond the last tier", dropped)
-
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     model_sharded = bool(config.model_sharded)
@@ -393,26 +581,33 @@ def train_als(ratings: Ratings, config: ALSConfig, mesh=None, *,
         log.warning("model_sharded requested but mesh %s has no 'model' "
                     "axis; training with replicated factors", dict(mesh.shape))
         model_sharded = False
-    # factor matrices: rows over the model axis when tensor-parallel,
-    # replicated otherwise — initial v, restored checkpoints, and the train
-    # step's outputs all use the same placement. NamedSharding requires dim
-    # 0 divisible by the model-axis size, so the on-device factor matrices
-    # are row-padded to nu_p/ni_p; blocks only ever gather rows < true
-    # size, and everything host-facing (checkpoints, the final model) is
-    # sliced back to true size.
-    ms_size = mesh.shape["model"] if model_sharded else 1
-    nu_p = -(-nu // ms_size) * ms_size
-    ni_p = -(-ni // ms_size) * ms_size
+
+    u_lay, i_lay = build_bilinear_layout(
+        ratings.user_indices, ratings.item_indices, ratings.ratings, nu, ni,
+        tiers=config.tiers, gather_budget=config.gather_budget,
+        seed=config.seed, chunk_cap=config.chunk_cap,
+        align=mesh.shape["model"] if model_sharded else 8,
+    )
+    dropped = u_lay.dropped + i_lay.dropped
+    if dropped:
+        log.info("degree tiers dropped %d entries beyond the last tier", dropped)
+    # factor matrices live in PERMUTED slot order during training
+    # (tier-concatenation order, SideLayout.pos maps true rows to slots);
+    # slot counts are 8-aligned so rows shard evenly over the model axis
+    # when tensor-parallel. Everything host-facing (checkpoints, the
+    # final model) is unpermuted via pos.
     fac = NamedSharding(mesh, P("model" if model_sharded else None, None))
 
-    def _pad_rows(arr, n_pad):
-        if arr.shape[0] == n_pad:
-            return arr
-        return jnp.concatenate(
-            [arr, jnp.zeros((n_pad - arr.shape[0],) + arr.shape[1:], arr.dtype)])
     vals_dtype = "bfloat16" if config.compute_dtype == "bfloat16" else None
-    u_bk = _put_buckets(user_buckets, mesh, vals_dtype=vals_dtype)
-    i_bk = _put_buckets(item_buckets, mesh, vals_dtype=vals_dtype)
+    u_bk = put_layout(u_lay, mesh, vals_dtype=vals_dtype)
+    i_bk = put_layout(i_lay, mesh, vals_dtype=vals_dtype)
+
+    def _to_slots(host_arr, lay):
+        """True-row-order host array -> permuted device layout (non-owner
+        slots stay exactly zero: padded ids gather from them)."""
+        perm = np.zeros((lay.slots, rank), np.float32)
+        perm[lay.pos] = np.asarray(host_arr)
+        return jax.device_put(perm, fac)
 
     # run fingerprint: a checkpoint is only resumable for the exact same
     # ratings + config — resuming across changed data or hyperparameters
@@ -442,9 +637,10 @@ def train_als(ratings: Ratings, config: ALSConfig, mesh=None, *,
         if restored is not None:
             ck_step, state = restored
             start_it = int(state["it"])
-            v = jax.device_put(_pad_rows(jnp.asarray(state["v"]), ni_p), fac)
-            u_restored = jax.device_put(
-                _pad_rows(jnp.asarray(state["u"]), nu_p), fac)
+            # checkpoints hold true-row-order arrays (resumable under any
+            # mesh/layout); re-permute into this run's slot order
+            v = _to_slots(state["v"], i_lay)
+            u_restored = _to_slots(state["u"], u_lay)
             log.info("resuming ALS from checkpoint step %d (iter %d)",
                      ck_step, start_it)
         elif checkpointer.steps():
@@ -467,15 +663,16 @@ def train_als(ratings: Ratings, config: ALSConfig, mesh=None, *,
     if v is None:
         key = jax.random.PRNGKey(config.seed)
         _k_u, k_v = jax.random.split(key)
-        # MLlib-style init: small positive factors
-        v = jax.device_put(
-            jnp.abs(jax.random.normal(k_v, (ni_p, rank), dtype=jnp.float32)) / jnp.sqrt(rank),
-            fac,
-        )
+        # MLlib-style init: small positive factors (true rows only — the
+        # layout's padding slots must stay exactly zero)
+        v = _to_slots(
+            np.abs(np.asarray(jax.random.normal(k_v, (ni, rank),
+                                                dtype=jnp.float32)))
+            / np.sqrt(rank), i_lay)
 
     step = make_train_step(
-        mesh, rank=rank, lambda_=config.lambda_,
-        implicit=config.implicit_prefs, alpha=config.alpha, nu=nu_p, ni=ni_p,
+        mesh, u_lay, i_lay, rank=rank, lambda_=config.lambda_,
+        implicit=config.implicit_prefs, alpha=config.alpha,
         model_sharded=model_sharded,
         compute_dtype=config.compute_dtype, solver=config.solver,
         cg_iters=config.cg_iters,
@@ -488,26 +685,27 @@ def train_als(ratings: Ratings, config: ALSConfig, mesh=None, *,
                 and (done % checkpoint_every == 0 or done == config.iterations)):
             # both sides: the final model pairs u_k (solved from v_{k-1})
             # with v_k, so v alone cannot reconstruct it exactly.
-            # checkpoints hold true-size (unpadded) arrays — they must be
-            # resumable on a mesh with a different model-axis size
-            checkpointer.save(done, {"u": np.asarray(u)[:nu],
-                                     "v": np.asarray(v)[:ni],
+            # checkpoints hold true-row-order arrays — they must be
+            # resumable under any mesh/layout permutation
+            checkpointer.save(done, {"u": np.asarray(u)[u_lay.pos],
+                                     "v": np.asarray(v)[i_lay.pos],
                                      "it": np.int64(done),
                                      "fp": np.uint64(fp)})
     if u is None:
         # checkpoint was already at the final iteration
-        u = u_restored if u_restored is not None else _solve_side(
-            u_bk, v, nu_p, kw=dict(
+        u = u_restored if u_restored is not None else jax.jit(
+            lambda bk, vv: _solve_side(bk, u_lay, vv, kw=dict(
                 lambda_=config.lambda_, implicit=config.implicit_prefs,
                 alpha=config.alpha, rank=rank,
                 compute_dtype=config.compute_dtype, solver=config.solver,
-                cg_iters=config.cg_iters))
+                cg_iters=_resolve_cg_iters(
+                    config.cg_iters, config.implicit_prefs))))(u_bk, v)
     u.block_until_ready()
     log.info("ALS done: %d iters, U %s, V %s", config.iterations, (nu, rank), (ni, rank))
 
     return ALSModel(
-        user_factors=np.asarray(u)[:nu],
-        item_factors=np.asarray(v)[:ni],
+        user_factors=np.asarray(u)[u_lay.pos],
+        item_factors=np.asarray(v)[i_lay.pos],
         user_ids=ratings.user_ids,
         item_ids=ratings.item_ids,
         config=config,

@@ -88,10 +88,11 @@ class TestNeighborBlocksParity:
             np.zeros(0, np.float32), 10, block_rows=8)
         np.testing.assert_array_equal(nat.ids, ref.ids)
 
-    def test_degree_buckets_use_native(self):
+    def test_bilinear_layout_uses_native(self):
         rows, cols, vals = _coo(4000, 200, 300, heavy_row=3, heavy_n=200)
-        bk = neighbors.build_degree_buckets(rows, cols, vals, 200)
-        total = sum(int(b.blocks.mask.sum()) for b in bk)
+        u_lay, i_lay = neighbors.build_bilinear_layout(
+            rows, cols, vals, 200, 300)
+        total = sum(int(b.mask.sum()) for b in u_lay.buckets)
         assert total == len(rows)
 
 
