@@ -114,12 +114,43 @@ def run_bench(n_ratings: int, iters: int, device_kind: str,
             "u": np.asarray(u)[u_lay.pos], "v": np.asarray(v)[i_lay.pos]}
 
 
+def dispatch_floor_ms(n: int = 50) -> float:
+    """Per-call client->device round-trip floor: a jitted identity on an
+    8-float array, result pulled each call. Every per-call wall latency
+    below includes this platform constant — report it explicitly so the
+    wall p50 cannot masquerade as kernel time."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x + 1.0)
+    x = jnp.zeros((8,), jnp.float32)
+    np.asarray(f(x))  # compile
+    lat = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        np.asarray(f(x))
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    return lat[len(lat) // 2] * 1e3
+
+
 def predict_latency(u: np.ndarray, v: np.ndarray, n_queries: int = 100) -> dict:
-    """BASELINE.json's second headline: predict p50 on the trained ML-20M
-    factors — single top-10 queries through the device-resident fused
-    retrieval kernel, plus a 64-query micro-batch for the loaded-server
-    number."""
-    from predictionio_tpu.ops.retrieval import DeviceRetriever
+    """BASELINE.json's second headline: predict latency on the trained
+    ML-20M factors through the device-resident fused retrieval kernel.
+
+    Reports FOUR numbers (VERDICT r2 Missing #1 — the wall p50 alone is a
+    remote-dispatch constant, not a serving latency):
+    - predict_p50_ms: per-call wall p50, single top-10 query (the full
+      client path, incl. the platform dispatch round trip);
+    - dispatch_floor_ms: that round trip measured on a no-op;
+    - predict_device_ms: amortized per-query device time of the top-k
+      kernel (iters kernel runs inside one dispatch);
+    - predict_batch64_ms: 64-query micro-batch wall median (the
+      micro-batching dispatcher's unit of work).
+    Reference mechanism being replaced: per-request serving-seconds
+    bookkeeping, CreateServer.scala:552-559.
+    """
+    from predictionio_tpu.ops.retrieval import DeviceRetriever, topk_device_seconds
 
     ret = DeviceRetriever(v)
     ret.topk(u[0], 10)  # compile the single-query kernel shape
@@ -137,10 +168,189 @@ def predict_latency(u: np.ndarray, v: np.ndarray, n_queries: int = 100) -> dict:
         ret.topk(u[:64], 10)
         blat.append(time.perf_counter() - t0)
     batch64 = sorted(blat)[len(blat) // 2] * 1e3  # median, like the p50
-    log(f"predict p50 {p50:.2f} ms single; batch-64 {batch64:.1f} ms "
+    dev_ms = topk_device_seconds(ret, 10) * 1e3
+    floor = dispatch_floor_ms()
+    log(f"predict p50 {p50:.2f} ms single wall (dispatch floor {floor:.1f} ms, "
+        f"device {dev_ms:.3f} ms); batch-64 {batch64:.1f} ms "
         f"({64 / batch64 * 1e3:.0f} qps)")
     return {"predict_p50_ms": round(p50, 2),
-            "predict_batch64_ms": round(batch64, 1)}
+            "predict_batch64_ms": round(batch64, 1),
+            "predict_device_ms": round(dev_ms, 3),
+            "dispatch_floor_ms": round(floor, 2)}
+
+
+def catalog_1m_latency() -> dict:
+    """BASELINE config 3's 1M-item catalog point: p50 wall + device time
+    for top-10 retrieval over synthetic 1M x 64 factors."""
+    from predictionio_tpu.ops.retrieval import DeviceRetriever, topk_device_seconds
+
+    rng = np.random.default_rng(2)
+    items = (rng.normal(size=(1_000_000, RANK)) / np.sqrt(RANK)).astype(np.float32)
+    q = (rng.normal(size=(64, RANK)) / np.sqrt(RANK)).astype(np.float32)
+    ret = DeviceRetriever(items)
+    ret.topk(q[0], 10)  # compile
+    lat = []
+    for i in range(60):
+        t0 = time.perf_counter()
+        ret.topk(q[i % 64], 10)
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    p50 = lat[len(lat) // 2] * 1e3
+    dev_ms = topk_device_seconds(ret, 10, iters=32) * 1e3
+    log(f"catalog-1M predict p50 {p50:.2f} ms wall, device {dev_ms:.3f} ms")
+    return {"catalog_1m_p50_ms": round(p50, 2),
+            "catalog_1m_device_ms": round(dev_ms, 3)}
+
+
+def e2e_quickstart(run_label: str, cache_dir: str) -> float:
+    """BASELINE target 3: end-to-end `pio train` + `pio deploy` wall clock
+    for a quickstart-scale app (200k ratings), measured in a fresh
+    subprocess (interpreter + jax init + import + train + deploy + first
+    answered query — everything a user waits for). ``cache_dir`` is the
+    child's compilation cache: the caller passes a FRESH temp dir to the
+    cold run and reuses it for the warm run, so "cold" can never be
+    polluted by caches from earlier sessions."""
+    code = r"""
+import json, os, sys, time
+t_all = time.time()
+import numpy as np
+sys.path.insert(0, os.environ["REPO"])
+import jax
+# PIO_XLA_CACHE_DIR also steers cmd_train/cmd_deploy's cache (tools/cli),
+# so the child's ENTIRE compile path uses the bench-controlled directory —
+# a stray ~/.pio_tpu cache from earlier CLI use cannot fake a warm "cold"
+jax.config.update("jax_compilation_cache_dir", os.environ["PIO_XLA_CACHE_DIR"])
+from predictionio_tpu.storage import Storage
+from predictionio_tpu.storage.event import event_from_api_dict
+from predictionio_tpu.tools.cli import main as pio
+from predictionio_tpu.workflow import resolve_engine_factory
+from predictionio_tpu.workflow.create_server import EngineServer
+
+Storage.reset()
+Storage.configure("METADATA", "memory")
+Storage.configure("EVENTDATA", "memory")
+Storage.configure("MODELDATA", "memory")
+assert pio(["app", "new", "qbench"]) == 0
+app = Storage.get_metadata().app_get_by_name("qbench")
+ev = Storage.get_events()
+rng = np.random.default_rng(0)
+nu, ni, n = 5000, 2000, 200_000
+users = rng.integers(0, nu, n)
+items = rng.integers(0, ni, n)
+vals = np.round(rng.random(n) * 9 + 1) / 2
+for i in range(n):
+    ev.insert(event_from_api_dict({
+        "event": "rate", "entityType": "user", "entityId": f"u{users[i]}",
+        "targetEntityType": "item", "targetEntityId": f"i{items[i]}",
+        "properties": {"rating": float(vals[i])}}), app.id)
+import shutil, tempfile
+d = tempfile.mkdtemp()
+shutil.copytree(os.path.join(os.environ["REPO"], "templates", "recommendation"),
+                os.path.join(d, "engine"))
+ej = os.path.join(d, "engine", "engine.json")
+variant = json.loads(open(ej).read())
+variant["datasource"]["params"]["app_name"] = "qbench"
+open(ej, "w").write(json.dumps(variant))
+assert pio(["train", "--engine-dir", os.path.join(d, "engine")]) == 0
+insts = Storage.get_metadata().engine_instance_get_completed("default", "1", "default")
+engine = resolve_engine_factory("engine:engine_factory",
+                                engine_dir=os.path.join(d, "engine"))
+server = EngineServer(engine, insts[0])
+res = server.serve_query({"user": "u3", "num": 4})
+assert len(res["itemScores"]) == 4
+print("E2E", time.time() - t_all)
+"""
+    env = dict(os.environ, REPO=os.path.dirname(os.path.abspath(__file__)),
+               PIO_XLA_CACHE_DIR=cache_dir)
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, env=env, timeout=1800)
+    for line in out.stdout.splitlines():
+        if line.startswith("E2E "):
+            s = float(line.split()[1])
+            log(f"e2e train+deploy ({run_label}): {s:.1f}s")
+            return s
+    raise RuntimeError(f"e2e quickstart failed: {out.stdout[-500:]} "
+                       f"{out.stderr[-1000:]}")
+
+
+def factor_sharding_bench() -> dict:
+    """VERDICT r2 #6: a perf artifact for the tensor-parallel path — the
+    same small ALS timed on an (8,1) pure-data mesh vs a (4,2)
+    data x model mesh with sharded factors, on the 8-device virtual CPU
+    mesh (multi-chip hardware is not available; correctness of the mesh
+    invariance is pinned by test_als)."""
+    code = r"""
+import os, sys, time
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, os.environ["REPO"])
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from predictionio_tpu.models.als import make_train_step, put_layout
+from predictionio_tpu.ops.neighbors import build_bilinear_layout
+from predictionio_tpu.parallel.mesh import make_mesh
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+rng = np.random.default_rng(0)
+nu, ni, n, rank = 20_000, 5_000, 500_000, 32
+users = rng.integers(0, nu, n).astype(np.int64)
+items = rng.integers(0, ni, n).astype(np.int64)
+vals = (rng.random(n) * 4 + 1).astype(np.float32)
+for shape, model_sharded in (((8, 1), False), ((4, 2), True)):
+    mesh = make_mesh(shape, ("data", "model"))
+    align = mesh.shape["model"] if model_sharded else 8
+    u_lay, i_lay = build_bilinear_layout(users, items, vals, nu, ni, align=align)
+    u_bk = put_layout(u_lay, mesh)
+    i_bk = put_layout(i_lay, mesh)
+    v_host = np.zeros((i_lay.slots, rank), np.float32)
+    v_host[i_lay.pos] = np.abs(rng.normal(size=(ni, rank))).astype(np.float32) / np.sqrt(rank)
+    spec = P("model", None) if model_sharded else P(None, None)
+    v = jax.device_put(v_host, NamedSharding(mesh, spec))
+    step = make_train_step(mesh, u_lay, i_lay, rank=rank, lambda_=0.1,
+                           model_sharded=model_sharded)
+    u, v = step(u_bk, i_bk, v)
+    np.asarray(u.ravel()[:4])
+    t0 = time.time()
+    for _ in range(3):
+        u, v = step(u_bk, i_bk, v)
+    np.asarray(u.ravel()[:4])
+    print(f"MESH {shape[0]}x{shape[1]} {3 / (time.time() - t0):.3f}")
+"""
+    env = dict(os.environ, REPO=os.path.dirname(os.path.abspath(__file__)),
+               JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, env=env, timeout=1800)
+    res = {}
+    for line in out.stdout.splitlines():
+        if line.startswith("MESH "):
+            _, shape, val = line.split()
+            key = ("sharding_8x1_iters_per_sec" if shape == "8x1"
+                   else "sharding_4x2_iters_per_sec")
+            res[key] = float(val)
+    if len(res) != 2:
+        raise RuntimeError(f"sharding bench failed: {out.stdout[-500:]} "
+                           f"{out.stderr[-1000:]}")
+    log(f"factor sharding (virtual CPU mesh): data-only 8x1 "
+        f"{res['sharding_8x1_iters_per_sec']:.3f} it/s vs data x model 4x2 "
+        f"{res['sharding_4x2_iters_per_sec']:.3f} it/s")
+    return res
+
+
+def _cache_dir() -> str:
+    d = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".xla_cache")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def enable_compile_cache() -> None:
+    """Persistent XLA compilation cache (VERDICT r2 #4): the second run of
+    any shape skips compilation entirely. Shared with the CLI train path
+    (tools/cli.py) via the same repo-local directory."""
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", _cache_dir())
 
 
 def cpu_floor() -> float:
@@ -214,14 +424,30 @@ def main() -> None:
     # accumulation + f32 solve); the CPU floor stays f32 — each substrate
     # runs its natural best configuration. The accuracy gate above ties
     # the fast config's model quality to the exact solver's.
+    enable_compile_cache()
     gap = accuracy_gate()
     result = run_bench(N_RATINGS, TIMED_ITERS, "chip", compute_dtype="bfloat16")
     value = result["iters_per_sec"]
+    extras: dict = {}
+    for name, fn in (
+        ("predict latency", lambda: predict_latency(result["u"], result["v"])),
+        ("catalog-1M latency", catalog_1m_latency),
+        ("factor sharding", factor_sharding_bench),
+    ):
+        try:
+            extras.update(fn())
+        except Exception as e:  # noqa: BLE001 — secondary, not load-bearing
+            log(f"{name} unavailable: {e}")
     try:
-        latency = predict_latency(result["u"], result["v"])
-    except Exception as e:  # noqa: BLE001 — latency is secondary, not load-bearing
-        log(f"predict latency unavailable: {e}")
-        latency = {}
+        import tempfile
+
+        with tempfile.TemporaryDirectory(prefix="pio_e2e_cache_") as cd:
+            extras["e2e_train_deploy_cold_s"] = round(
+                e2e_quickstart("cold", cd), 1)
+            extras["e2e_train_deploy_s"] = round(
+                e2e_quickstart("warm cache", cd), 1)
+    except Exception as e:  # noqa: BLE001
+        log(f"e2e quickstart unavailable: {e}")
     try:
         floor = cpu_floor()
         log(f"cpu floor (scaled to 20M): {floor:.4f} iters/sec")
@@ -236,7 +462,7 @@ def main() -> None:
         "vs_baseline": round(vs, 2),
         "config": {"compute_dtype": "bfloat16", "solver": "cg",
                    "accuracy_gap_rmse": round(gap, 6),
-                   "floor_config": "float32/cg", **latency},
+                   "floor_config": "float32/cg", **extras},
     }))
 
 

@@ -422,12 +422,28 @@ def put_layout(layout, mesh, *, vals_dtype=None):
     validity is encoded in vals, and padded ids point at the other side's
     zero slot (ops/neighbors.py). ``vals_dtype=bfloat16`` halves the
     ratings' transfer + HBM footprint (exact for half-star ratings;
-    otherwise a rounding the bf16 compute path would apply anyway)."""
+    otherwise a rounding the bf16 compute path would apply anyway).
+
+    Under a multi-process mesh (``jax.process_count() > 1``) each process
+    contributes only ITS device-local slice of every block via
+    ``jax.make_array_from_process_local_data`` — the executor-side half of
+    the Spark factor-block distribution this design replaces
+    (reference examples/.../ALSModel.scala:172-179); the caller feeds each
+    process the same (deterministically rebuilt) layout."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     blk = NamedSharding(mesh, P(None, "data", None))
     rep = NamedSharding(mesh, P())
+    multi = jax.process_count() > 1
+
+    def put(arr, sharding):
+        if not multi:
+            return jax.device_put(arr, sharding)
+        return jax.make_array_from_process_local_data(
+            sharding, _process_local_slice(arr, sharding),
+            global_shape=arr.shape)
+
     out = []
     for b, m in zip(layout.buckets, layout.metas):
         vals = b.vals
@@ -436,11 +452,34 @@ def put_layout(layout, mesh, *, vals_dtype=None):
 
             dt = ml_dtypes.bfloat16 if vals_dtype == "bfloat16" else vals_dtype
             vals = vals.astype(dt)
-        e = {"ids": jax.device_put(b.ids, blk),
-             "vals": jax.device_put(vals, blk)}
+        e = {"ids": put(b.ids, blk), "vals": put(vals, blk)}
         if m.seg is not None:
-            e["seg"] = jax.device_put(m.seg, rep)
+            e["seg"] = put(m.seg, rep)
         out.append(e)
+    return out
+
+
+def _process_local_slice(arr, sharding):
+    """This process's contiguous slice of a host array for
+    ``make_array_from_process_local_data`` (jax device order is
+    process-major, so each process's shards are one contiguous range
+    along every sharded dim; replicated dims pass through whole)."""
+    import jax
+
+    pid, pc = jax.process_index(), jax.process_count()
+    out = arr
+    for dim, part in enumerate(sharding.spec):
+        if part is None:
+            continue
+        if sharding.mesh.shape[part] % pc or arr.shape[dim] % pc:
+            raise ValueError(
+                f"dim {dim} (axis {part!r}) does not split evenly over "
+                f"{pc} processes: mesh axis {sharding.mesh.shape[part]}, "
+                f"dim size {arr.shape[dim]}")
+        step = arr.shape[dim] // pc
+        sl = [slice(None)] * arr.ndim
+        sl[dim] = slice(pid * step, (pid + 1) * step)
+        out = out[tuple(sl)]
     return out
 
 

@@ -100,20 +100,17 @@ def _topk_kernel(q_ref, items_ref, vals_ref, idx_ref, *, k, tile_n, n_total):
         idx_ref[:] = out_i
 
 
-@functools.partial(
-    # bounded: a long-lived server reloading a growing catalog must not
-    # accumulate one compiled kernel per historical catalog size. 32 covers
-    # the pow2-padded batch sizes x rounded k values of steady serving.
-    functools.lru_cache(maxsize=32),
-)
-def _build_call(B, D, N_pad, n_total, k, tile_n, interpret):
+def _raw_call(B, D, N_pad, n_total, k, tile_n, interpret):
+    """The un-jitted fused top-k pallas call — shared by the jitted
+    serving entry (`_build_call`) and the device-time spin
+    (`topk_device_seconds`), which wraps it in its own scan+jit."""
     import jax
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     grid = (N_pad // tile_n,)
     kernel = functools.partial(_topk_kernel, k=k, tile_n=tile_n, n_total=n_total)
-    call = pl.pallas_call(
+    return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -130,7 +127,56 @@ def _build_call(B, D, N_pad, n_total, k, tile_n, interpret):
         ],
         interpret=interpret,
     )
-    return jax.jit(call)
+
+
+@functools.partial(
+    # bounded: a long-lived server reloading a growing catalog must not
+    # accumulate one compiled kernel per historical catalog size. 32 covers
+    # the pow2-padded batch sizes x rounded k values of steady serving.
+    functools.lru_cache(maxsize=32),
+)
+def _build_call(B, D, N_pad, n_total, k, tile_n, interpret):
+    import jax
+
+    return jax.jit(_raw_call(B, D, N_pad, n_total, k, tile_n, interpret))
+
+
+def topk_device_seconds(retriever: "DeviceRetriever", k: int,
+                        iters: int = 64) -> float:
+    """Amortized per-query DEVICE time of the fused top-k kernel: `iters`
+    single-query kernel invocations inside ONE jitted scan (one dispatch
+    total), wall clock divided by `iters`. On remote-dispatch platforms a
+    per-call wall p50 measures the client round trip, not the kernel —
+    this is the honest device-side number to report next to it
+    (VERDICT r2: the serving headline must split device time from the
+    dispatch floor)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    d = retriever._items.shape[1]
+    b_pad, k_pad = _query_shapes(1, min(k, retriever.n_total),
+                                 retriever.n_total)
+    call = _raw_call(b_pad, d, retriever._items.shape[0], retriever.n_total,
+                     k_pad, retriever._tile_n, retriever._interpret)
+    qs = jnp.asarray(
+        np.random.default_rng(0).normal(size=(iters, b_pad, d)),
+        jnp.float32)
+
+    @jax.jit
+    def spin(qs, items):
+        def body(acc, qi):
+            vals, idx = call(qi, items)
+            return acc + vals.sum() + idx.sum().astype(jnp.float32), None
+
+        acc, _ = jax.lax.scan(body, jnp.float32(0), qs)
+        return acc
+
+    float(spin(qs, retriever._items))  # compile + warm
+    t0 = time.perf_counter()
+    float(spin(qs, retriever._items))  # blocks on the scalar result
+    return (time.perf_counter() - t0) / iters
 
 
 def _pad_items(items: np.ndarray, n_total: int, tile_n: int) -> tuple[np.ndarray, int]:
@@ -139,6 +185,19 @@ def _pad_items(items: np.ndarray, n_total: int, tile_n: int) -> tuple[np.ndarray
     it = _pad_to(items, 128, 1)
     tile_n = min(tile_n, max(128, ((n_total + 127) // 128) * 128))
     return _pad_to(it, tile_n, 0), tile_n
+
+
+def _query_shapes(b: int, k_eff: int, n_total: int) -> tuple[int, int]:
+    """Shape discipline on the serving hot path: batch padded to a power
+    of two (>=8) and k rounded up to a multiple of 8, so traffic-dependent
+    batch sizes / client-chosen num values map onto a handful of compiled
+    kernels instead of one per (B, k) pair. The ONE home of this policy —
+    `_run_topk` (serving) and `topk_device_seconds` (the bench's device-
+    time spin) must time the same kernel shape."""
+    b_pad = 8
+    while b_pad < b:
+        b_pad *= 2
+    return b_pad, min(((k_eff + 7) // 8) * 8, n_total)
 
 
 def _run_topk(q: np.ndarray, items_dev, n_total: int, k: int, tile_n: int,
@@ -157,16 +216,9 @@ def _run_topk(q: np.ndarray, items_dev, n_total: int, k: int, tile_n: int,
         empty_i = np.zeros((q.shape[0], 0), np.int32)
         return (empty_v[0], empty_i[0]) if single else (empty_v, empty_i)
     b_orig = q.shape[0]
-    # shape discipline on the serving hot path: batch padded to a power of
-    # two (>=8) and k rounded up to a multiple of 8, so traffic-dependent
-    # batch sizes / client-chosen num values map onto a handful of
-    # compiled kernels instead of one per (B, k) pair
-    b_pad = 8
-    while b_pad < q.shape[0]:
-        b_pad *= 2
+    b_pad, k_pad = _query_shapes(q.shape[0], k_eff, n_total)
     q = _pad_to(q, b_pad, 0)
     q = _pad_to(q, 128, 1)
-    k_pad = min(((k_eff + 7) // 8) * 8, n_total)
     call = _build_call(
         q.shape[0], items_dev.shape[1], items_dev.shape[0], n_total, k_pad,
         tile_n, interpret,

@@ -47,6 +47,24 @@ class Ratings:
     def __len__(self) -> int:
         return int(self.ratings.shape[0])
 
+    @classmethod
+    def from_triples(cls, users: Sequence[str], items: Sequence[str],
+                     ratings: Sequence[float]) -> "Ratings":
+        """String-id (user, item, rating) triples -> dense-indexed
+        Ratings — the custom-datasource entry point (the reference's
+        BiMap.stringInt reindex, BiMap.scala:72-126, for data that never
+        went through the event store). Same vectorized reindex as
+        ``EventFrame.to_ratings``."""
+        u_map, uidx = BiMap.from_array(np.asarray(users, dtype=object))
+        i_map, iidx = BiMap.from_array(np.asarray(items, dtype=object))
+        return cls(
+            user_indices=uidx.astype(np.int64),
+            item_indices=iidx.astype(np.int64),
+            ratings=np.asarray(ratings, np.float32),
+            user_ids=u_map,
+            item_ids=i_map,
+        )
+
 
 class EventFrame:
     """A batch of events in columnar (struct-of-arrays) form.

@@ -30,6 +30,7 @@ import argparse
 import dataclasses
 import json
 import logging
+import os
 import sys
 from pathlib import Path
 
@@ -230,9 +231,28 @@ def cmd_unregister(args) -> int:
     return 0
 
 
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache under PIO_HOME: re-running train
+    (or deploy's retrain path) with the same shapes skips compilation —
+    the dominant setup cost of the end-to-end `pio train` wall clock
+    (BASELINE.md target 3). Safe to call before or after jax backend
+    init; shared with bench.py's cache by callers that set the same dir."""
+    try:
+        import jax
+
+        d = os.environ.get("PIO_XLA_CACHE_DIR") or os.path.join(
+            os.environ.get("PIO_HOME", os.path.expanduser("~/.pio_tpu")),
+            "xla_cache")
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+    except Exception:  # noqa: BLE001 — cache is an optimization, never fatal
+        pass
+
+
 def cmd_train(args) -> int:
     from ..workflow import Context, WorkflowParams, run_train
 
+    _enable_compile_cache()
     engine_dir = Path(args.engine_dir)
     variant = _load_variant(engine_dir, args.engine_json)
     engine = _engine_from_variant(engine_dir, variant)
@@ -272,6 +292,7 @@ def _parse_mesh(spec: str) -> tuple[int, ...]:
 
 
 def cmd_eval(args) -> int:
+    _enable_compile_cache()
     from ..workflow import Context, resolve_attr, run_evaluation
 
     engine_dir = Path(args.engine_dir)
@@ -301,6 +322,7 @@ def cmd_eval(args) -> int:
 
 
 def cmd_deploy(args) -> int:
+    _enable_compile_cache()
     from ..workflow.create_server import run_engine_server
 
     engine_dir = Path(args.engine_dir)

@@ -30,6 +30,8 @@ _DESCRIPTIONS = {
     "markovchain": "next-item Markov chain (e2 MarkovChain)",
     "stock": "stock backtest: indicators + regression strategy (scala-stock)",
     "helloworld": "minimal copy-me engine (per-day averages)",
+    "customdatasource": "tutorial: ALS from a ratings file — write your own DataSource (scala-parallel-recommendation-custom-datasource)",
+    "movielensevaluation": "worked example: k-fold tuning grid, 3-metric leaderboard, best.json + dashboard (scala-local-movielens-evaluation)",
 }
 
 

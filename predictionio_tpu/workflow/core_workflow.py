@@ -34,6 +34,10 @@ from .serialization import (
 
 log = logging.getLogger("predictionio_tpu.workflow")
 
+#: engine dir -> its sibling .py stems, registered on first scoped load —
+#: the basis for the (once-per-pair) sibling-name collision warning
+_SCOPED_ENGINE_DIRS: dict = {}
+
 __all__ = [
     "resolve_attr", "resolve_engine_factory", "run_train", "run_evaluation",
     "prepare_deploy",
@@ -61,8 +65,11 @@ def _import_engine_scoped(engine_dir, mod_name: str):
     plain-named entries are evicted afterwards); the dir then stays
     APPENDED to sys.path so lazy imports at predict/serve time still
     resolve. With several engines whose *siblings* share names, a lazy
-    sibling import binds by sys.path order — prefer eager imports in
-    engine modules.
+    sibling import binds by sys.path order — that hazard is DETECTED at
+    load time: when a newly loaded engine dir carries sibling .py names
+    that an earlier-loaded engine dir also has, a warning names the
+    collisions so engine authors move those imports into the module body
+    (eager imports are always engine-correct).
     """
     import hashlib
     import importlib.util
@@ -74,6 +81,20 @@ def _import_engine_scoped(engine_dir, mod_name: str):
     pkg = d / top / "__init__.py"
     if not file.exists() and not pkg.exists():
         return None
+    if d not in _SCOPED_ENGINE_DIRS:
+        # one glob per NEW dir; collision pairs warn once (repeat resolves
+        # of already-registered engines cost nothing and stay quiet)
+        siblings = frozenset(p.stem for p in d.glob("*.py")) - {top}
+        for prev, prev_sibs in _SCOPED_ENGINE_DIRS.items():
+            clash = siblings & prev_sibs
+            if clash:
+                log.warning(
+                    "engine dirs %s and %s both define sibling module(s) "
+                    "%s: a LAZY `import <name>` at predict/serve time "
+                    "binds by sys.path order and may load the other "
+                    "engine's file — import siblings at engine-module "
+                    "top level instead", d, prev, sorted(clash))
+        _SCOPED_ENGINE_DIRS[d] = siblings
     key = hashlib.sha1(str(d).encode()).hexdigest()[:10]
     uniq_top = f"_pio_engine_{key}_{top}"
     if uniq_top not in sys.modules:

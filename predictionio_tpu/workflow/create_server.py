@@ -362,6 +362,15 @@ def create_engine_server_app(server: EngineServer) -> web.Application:
     app.router.add_get("/", handle_status)
     app.router.add_get("/reload", handle_reload)
     app.router.add_get("/stop", handle_stop)
+
+    async def _close_batcher(app):
+        # drain + stop the micro-batch dispatcher on shutdown so pending
+        # batched futures resolve instead of leaking when /stop (or any
+        # app teardown) fires; MicroBatcher.close() is idempotent
+        if server.batcher is not None:
+            await server.batcher.close()
+
+    app.on_cleanup.append(_close_batcher)
     return app
 
 

@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Emit MovieLens-100k-shaped rate events as import-ready JSON lines.
+
+The stand-in for downloading u.data in a zero-egress environment (the
+reference's movielens-evaluation example preloads MovieLens events the
+same way, via its import scripts): zipf-popular items, per-user taste
+from a low-rank latent model, so the tuning grid in engine.py has real
+structure to find.
+
+    python templates/movielensevaluation/data/gen_movielens.py > ml.jsonl
+    python -m predictionio_tpu.tools.cli import --appid <id> --input ml.jsonl
+"""
+
+import argparse
+import json
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--users", type=int, default=400)
+    ap.add_argument("--items", type=int, default=200)
+    ap.add_argument("--ratings", type=int, default=20_000)
+    ap.add_argument("--seed", type=int, default=42)
+    args = ap.parse_args()
+
+    rng = np.random.default_rng(args.seed)
+    u = rng.normal(size=(args.users, 5)) / np.sqrt(5) + 0.6
+    v = rng.normal(size=(args.items, 5)) / np.sqrt(5) + 0.6
+    pop = 1.0 / np.arange(1, args.items + 1) ** 0.8
+    pop /= pop.sum()
+    users = rng.integers(0, args.users, args.ratings)
+    items = rng.choice(args.items, size=args.ratings, p=pop)
+    scores = np.clip(np.round((u[users] * v[items]).sum(1) * 2) / 2, 0.5, 5.0)
+    for k in range(args.ratings):
+        print(json.dumps({
+            "event": "rate",
+            "entityType": "user", "entityId": f"u{users[k]}",
+            "targetEntityType": "item", "targetEntityId": f"i{items[k]}",
+            "properties": {"rating": float(scores[k])},
+            "eventTime": "2020-01-01T00:00:00Z",
+        }))
+
+
+if __name__ == "__main__":
+    main()

