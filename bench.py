@@ -221,7 +221,6 @@ import jax
 # a stray ~/.pio_tpu cache from earlier CLI use cannot fake a warm "cold"
 jax.config.update("jax_compilation_cache_dir", os.environ["PIO_XLA_CACHE_DIR"])
 from predictionio_tpu.storage import Storage
-from predictionio_tpu.storage.event import event_from_api_dict
 from predictionio_tpu.tools.cli import main as pio
 from predictionio_tpu.workflow import resolve_engine_factory
 from predictionio_tpu.workflow.create_server import EngineServer
@@ -232,18 +231,24 @@ Storage.configure("EVENTDATA", "memory")
 Storage.configure("MODELDATA", "memory")
 assert pio(["app", "new", "qbench"]) == 0
 app = Storage.get_metadata().app_get_by_name("qbench")
-ev = Storage.get_events()
 rng = np.random.default_rng(0)
 nu, ni, n = 5000, 2000, 200_000
 users = rng.integers(0, nu, n)
 items = rng.integers(0, ni, n)
 vals = np.round(rng.random(n) * 9 + 1) / 2
+import tempfile
+jl = tempfile.NamedTemporaryFile("w", suffix=".jsonl", delete=False)
 for i in range(n):
-    ev.insert(event_from_api_dict({
-        "event": "rate", "entityType": "user", "entityId": f"u{users[i]}",
-        "targetEntityType": "item", "targetEntityId": f"i{items[i]}",
-        "properties": {"rating": float(vals[i])}}), app.id)
-import shutil, tempfile
+    jl.write(json.dumps({
+        "event": "rate", "entityType": "user", "entityId": "u%d" % users[i],
+        "targetEntityType": "item", "targetEntityId": "i%d" % items[i],
+        "properties": {"rating": float(vals[i])},
+        "eventTime": "2020-01-01T00:00:00Z"}) + "\n")
+jl.close()
+# the real quickstart bulk path: pio import (C++ scanner fast path)
+assert pio(["import", "--appid", str(app.id), "--input", jl.name]) == 0
+os.unlink(jl.name)
+import shutil
 d = tempfile.mkdtemp()
 shutil.copytree(os.path.join(os.environ["REPO"], "templates", "recommendation"),
                 os.path.join(d, "engine"))

@@ -38,8 +38,6 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-from functools import partial
-from typing import Any
 
 import numpy as np
 
