@@ -110,6 +110,22 @@ def run_bench(n_ratings: int, iters: int, device_kind: str,
     dt = max(time.time() - t0 - pull_cost, 1e-9)
     assert np.isfinite(final).all()
     log(f"[{device_kind}] {iters} iters in {dt:.2f}s -> {iters/dt:.3f} iters/sec")
+
+    # PIO_BENCH_PROFILE=<dir>: capture a jax.profiler trace of one extra
+    # iteration for offline XProf/TensorBoard inspection (the workflow
+    # tracing hook, workflow/tracing.py; non-fatal — some remote
+    # platforms cannot host the profiler service)
+    prof_dir = os.environ.get("PIO_BENCH_PROFILE")
+    if prof_dir:
+        try:
+            from predictionio_tpu.workflow.tracing import maybe_profile
+
+            with maybe_profile(prof_dir):
+                u, v = step(u_bk, i_bk, v)
+                pull(u)
+            log(f"[{device_kind}] profiler trace captured -> {prof_dir}")
+        except Exception as e:  # noqa: BLE001
+            log(f"[{device_kind}] profiler capture unavailable: {e}")
     return {"iters_per_sec": iters / dt, "n_ratings": n_ratings,
             "u": np.asarray(u)[u_lay.pos], "v": np.asarray(v)[i_lay.pos]}
 
@@ -343,6 +359,63 @@ for shape, model_sharded in (((8, 1), False), ((4, 2), True)):
     return res
 
 
+def event_ingest_throughput() -> dict:
+    """Event-server ingestion rate through the REAL HTTP plane (:7070
+    analog): batched POST /batch/events.json, single client. The
+    reference publishes no ingestion numbers (BASELINE.md — its Stats
+    mechanism only counts); this line establishes ours. Runs in a
+    subprocess on the CPU backend (no accelerator in this plane)."""
+    code = r"""
+import json, os, sys, time
+sys.path.insert(0, os.environ["REPO"])
+import requests
+
+from predictionio_tpu.storage import Storage
+from predictionio_tpu.api.event_server import create_event_app
+
+Storage.reset()
+Storage.configure("METADATA", "memory")
+Storage.configure("EVENTDATA", "memory")
+meta = Storage.get_metadata()
+app_rec = meta.app_insert("ingest")
+Storage.get_events().init_app(app_rec.id)
+ak = meta.access_key_insert(app_rec.id)
+
+sys.path.insert(0, os.path.join(os.environ["REPO"], "tests"))
+from helpers import ServerThread
+st = ServerThread(create_event_app)
+try:
+    batch = [{
+        "event": "rate", "entityType": "user", "entityId": "u%d" % (i % 500),
+        "targetEntityType": "item", "targetEntityId": "i%d" % (i % 200),
+        "properties": {"rating": 4.0},
+        "eventTime": "2020-01-01T00:00:00Z"} for i in range(50)]
+    url = st.url + "/batch/events.json?accessKey=" + ak.key
+    s = requests.Session()
+    r = s.post(url, json=batch)
+    assert r.status_code == 200, r.text
+    n_rounds, t0 = 40, time.time()
+    for _ in range(n_rounds):
+        r = s.post(url, json=batch)
+        assert r.status_code == 200
+    dt = time.time() - t0
+    print("INGEST %.1f" % (n_rounds * len(batch) / dt))
+finally:
+    st.stop()
+"""
+    env = dict(os.environ, REPO=os.path.dirname(os.path.abspath(__file__)),
+               JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, env=env, timeout=600)
+    for line in out.stdout.splitlines():
+        if line.startswith("INGEST "):
+            rate = float(line.split()[1])
+            log(f"event ingest (HTTP batch, 1 client): {rate:.0f} events/sec")
+            return {"event_ingest_per_sec": round(rate, 1)}
+    raise RuntimeError(f"ingest bench failed: {out.stdout[-300:]} "
+                       f"{out.stderr[-800:]}")
+
+
 def _cache_dir() -> str:
     d = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".xla_cache")
     os.makedirs(d, exist_ok=True)
@@ -438,6 +511,7 @@ def main() -> None:
         ("predict latency", lambda: predict_latency(result["u"], result["v"])),
         ("catalog-1M latency", catalog_1m_latency),
         ("factor sharding", factor_sharding_bench),
+        ("event ingest", event_ingest_throughput),
     ):
         try:
             extras.update(fn())

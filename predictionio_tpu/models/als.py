@@ -77,10 +77,10 @@ class ALSConfig:
     lambda_: float = 0.1
     implicit_prefs: bool = False
     alpha: float = 1.0  # implicit confidence scale
-    #: degree tiers of the bucketed layout. "auto" (default) derives
-    #: geometric tiers from the observed max degree — zero dropped entries
-    #: and ~20% average padding; an explicit tuple is auto-extended to the
-    #: observed max so it is lossless too (ops/neighbors.py)
+    #: degree tiers of the bucketed layout. "auto" (default) computes
+    #: histogram-optimal edges (ops/neighbors.py optimal_tiers) — zero
+    #: dropped entries, ~5-15% padding; an explicit tuple is auto-extended
+    #: to the observed max so it is lossless too
     tiers: tuple | str = "auto"
     #: per-block gather budget in elements (B*D cap) — bounds peak memory
     gather_budget: int = 2_000_000
@@ -143,14 +143,30 @@ class ALSModel(RetrievalServingMixin):
                       candidate_mask: np.ndarray | None = None) -> list[tuple[int, float]]:
         """Cosine top-N against the whole catalog — the similarproduct
         template's scoring (examples/scala-parallel-similarproduct/multi/
-        src/main/scala/ALSAlgorithm.scala:146-200) as one matmul."""
+        src/main/scala/ALSAlgorithm.scala:146-200) as one retrieval.
+
+        With a similarity retriever attached (attach_similarity_retriever
+        — the engine server does this at deploy) the unfiltered path runs
+        the fused device top-k over the normalized catalog: aggregate
+        cosine = one query with the summed normalized query vectors.
+        Filtered queries (candidate_mask) fall back to the host matmul —
+        a mask can exclude arbitrarily much, so over-fetching from the
+        device result has no bound."""
+        from ..ops.retrieval import row_normalize
+
         if not item_rows:
             return []
-        q = self.item_factors[item_rows]  # [k, R]
-        qn = q / (np.linalg.norm(q, axis=1, keepdims=True) + 1e-9)
-        cn = self.item_factors / (
-            np.linalg.norm(self.item_factors, axis=1, keepdims=True) + 1e-9
-        )
+        qn = row_normalize(self.item_factors[item_rows])  # [k, R]
+        sim = getattr(self, "_sim_retriever", None)
+        if sim is not None and candidate_mask is None:
+            # fetch enough to survive dropping the query items themselves
+            vals, idx = sim.topk(qn.sum(0), min(num + len(item_rows),
+                                                sim.n_total))
+            skip = set(int(r) for r in item_rows)
+            out = [(int(i), float(v)) for v, i in zip(vals, idx)
+                   if i >= 0 and int(i) not in skip]
+            return out[:num]
+        cn = row_normalize(self.item_factors)
         scores = (cn @ qn.T).sum(axis=1)  # aggregate cosine over query items
         scores[item_rows] = -np.inf  # exclude the query items themselves
         if candidate_mask is not None:

@@ -23,7 +23,15 @@ import functools
 
 import numpy as np
 
-__all__ = ["topk_scores", "DeviceRetriever", "RetrievalServingMixin"]
+__all__ = ["topk_scores", "DeviceRetriever", "RetrievalServingMixin", "row_normalize"]
+
+
+def row_normalize(x: np.ndarray) -> np.ndarray:
+    """Unit-normalize rows (cosine scoring). The ONE home of the epsilon:
+    the device similarity retriever and the host cosine fallback must
+    score identically (test_als device/host parity pins it)."""
+    x = np.asarray(x, np.float32)
+    return x / (np.linalg.norm(x, axis=1, keepdims=True) + 1e-9)
 
 
 def _pad_to(x, mult, axis, value=0.0):
@@ -360,9 +368,20 @@ class RetrievalServingMixin:
             getattr(self, self._retrieval_attr), interpret=interpret
         )
 
+    def attach_similarity_retriever(self, interpret=None) -> None:
+        """Row-NORMALIZED catalog retriever: cosine similar-items serving
+        (the similarproduct family) as the same fused top-k kernel — an
+        aggregate cosine over query items is one retrieval with the
+        summed normalized query vectors (Σ over k query items of
+        cn·qn_k = cn·Σqn_k)."""
+        cn = row_normalize(getattr(self, self._retrieval_attr))
+        self._sim_retriever = DeviceRetriever(cn, interpret=interpret)
+
     def __getstate__(self):
         state = dict(self.__dict__)
-        state.pop("_retriever", None)  # device arrays never enter MODELDATA
+        # device arrays never enter MODELDATA
+        state.pop("_retriever", None)
+        state.pop("_sim_retriever", None)
         return state
 
     def _retriever_topk(self, query_vec, num, inverse_ids):

@@ -125,6 +125,11 @@ class _CosineModel:
         self.als = als
         self.item_categories = item_categories
 
+    def attach_retriever(self, interpret=None) -> None:
+        """Deploy hook (create_server.py): unfiltered similar-items
+        queries serve from the device-resident normalized catalog."""
+        self.als.attach_similarity_retriever(interpret)
+
     def query_rows(self, item_ids) -> list[int]:
         rows = [self.als.item_ids.get(i) for i in item_ids]
         return [r for r in rows if r is not None]

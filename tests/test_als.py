@@ -337,3 +337,71 @@ def test_zero_rating_mask_derivation(rng, mesh8):
     model = train_als(r, cfg, mesh=mesh8)
     assert np.isfinite(model.user_factors).all()
     assert np.isfinite(model.item_factors).all()
+
+
+def test_optimal_tiers_properties():
+    """DP tier edges: sorted, cover the max degree, and never cost more
+    than geometric edges under the same objective."""
+    from predictionio_tpu.ops.neighbors import geometric_tiers, optimal_tiers
+
+    rng = np.random.default_rng(0)
+    for degrees in (
+        rng.poisson(144, 5000) + 1,                      # ML-20M-ish users
+        (rng.pareto(1.2, 5000) * 20).astype(int) + 1,    # zipf-ish items
+        np.array([7]), np.array([1, 1, 1, 2048]),
+    ):
+        for cost in (1000, 100_000):
+            edges = optimal_tiers(degrees, tier_cost=cost)
+            assert list(edges) == sorted(edges)
+            assert all(e % 8 == 0 for e in edges)
+            assert edges[-1] >= degrees.max()
+
+            def objective(es):
+                tot = len(es) * cost
+                prev = 0
+                for e in es:
+                    sel = (degrees > prev) & (degrees <= e)
+                    tot += int(sel.sum()) * e
+                    prev = e
+                return tot
+
+            geo = geometric_tiers(int(degrees.max()))
+            assert objective(edges) <= objective(geo)
+    assert optimal_tiers(np.array([], dtype=int), tier_cost=10) == (8,)
+
+
+def test_block_rows_balanced():
+    """Block sizing ceil-divides rows over blocks: a tier one row past a
+    block boundary must not pad a whole extra block."""
+    from predictionio_tpu.ops.neighbors import _block_rows_for
+
+    b = _block_rows_for(152, 2_000_000, 8193)
+    nb = -(-8193 // b)
+    assert nb * b - 8193 < nb * 8  # waste bounded by 8 rows per block
+    assert b % 8 == 0
+    assert _block_rows_for(2048, 2_000_000, 0) == 8
+    # budget bound: B*D stays within the gather budget
+    b = _block_rows_for(2048, 2_000_000, 100_000)
+    assert b * 2048 <= 2_000_000 + 8 * 2048
+
+
+def test_similar_items_device_path_matches_host(rng, mesh8):
+    """The device similarity retriever (normalized-catalog fused top-k)
+    must rank identically to the host cosine matmul it replaces."""
+    ratings, _f, _m = make_ratings(rng, nu=30, ni=24)
+    model = train_als(ratings, ALSConfig(rank=6, iterations=6), mesh=mesh8)
+    host = model.similar_items([3, 7], num=5)
+    model.attach_similarity_retriever(interpret=True)
+    dev = model.similar_items([3, 7], num=5)
+    assert [i for i, _ in dev] == [i for i, _ in host]
+    np.testing.assert_allclose([s for _, s in dev], [s for _, s in host],
+                               rtol=1e-5, atol=1e-6)
+    # filtered queries still take the host path (masks have no bound)
+    cand = np.zeros(24, bool)
+    cand[5] = True
+    assert [i for i, _ in model.similar_items([3], 4, candidate_mask=cand)] == [5]
+    # the retriever never enters pickled MODELDATA
+    import pickle
+
+    m2 = pickle.loads(pickle.dumps(model))
+    assert not hasattr(m2, "_sim_retriever")
