@@ -70,11 +70,12 @@ class TierMeta:
     """Static facts the solver needs about one tier bucket."""
 
     span: int  # rows this tier contributes to the permuted factor array
-    #: None for regular tiers (block row j IS slot offset+j). For the
+    #: None for regular tiers (block row j IS slot offset+j). For a
     #: chunked tier: int32 [NB*B] mapping each block row (a chunk of a
     #: heavy row) to its owner's local slot 0..span-1, SORTED ascending —
     #: the solver segment-sums partial normal equations over it. Block
-    #: padding rows map to 0 (their contribution is exactly zero).
+    #: padding rows map to the last local slot (their contribution is
+    #: exactly zero, and a trailing index keeps the sequence sorted).
     seg: np.ndarray | None = None
 
 
