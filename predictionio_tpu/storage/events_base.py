@@ -124,11 +124,18 @@ class EventBackend(abc.ABC):
     def insert(self, event: Event, app_id: int, channel_id: int | None = None) -> str:
         """Insert one event, returning its assigned event id."""
 
+    #: True when ``insert_batch`` is all-or-nothing (a failure persists
+    #: NOTHING). The event server only takes the batch fast path for
+    #: atomic backends — a partial insert followed by a blanket 500 would
+    #: make clients re-send events that already landed.
+    BATCH_ATOMIC = False
+
     def insert_batch(
         self, events: Sequence[Event], app_id: int, channel_id: int | None = None
     ) -> list[str]:
         """Bulk insert (the import path; reference tools/imprt/FileToEvents
-        uses PEvents.write). Backends may override for a faster path."""
+        uses PEvents.write). Backends may override for a faster path; an
+        all-or-nothing override should also set ``BATCH_ATOMIC``."""
         return [self.insert(e, app_id, channel_id) for e in events]
 
     # -- point reads / deletes (LEvents.scala:71-103) ---------------------

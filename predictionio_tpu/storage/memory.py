@@ -33,6 +33,8 @@ class _Table:
 
 
 class MemoryEvents(EventBackend):
+    BATCH_ATOMIC = True  # see insert_batch: validated upfront, one lock
+
     def __init__(self, config: dict | None = None):
         self._tables: dict[tuple[int, int | None], _Table] = {}
         self._lock = threading.RLock()
@@ -73,6 +75,29 @@ class MemoryEvents(EventBackend):
             t.events.insert(pos, e)
             t.by_id[e.event_id] = e  # type: ignore[index]
             return e.event_id  # type: ignore[return-value]
+
+    def insert_batch(
+        self, events, app_id: int, channel_id: int | None = None
+    ) -> list[str]:
+        """All-or-nothing by construction (BATCH_ATOMIC): ids are
+        assigned before any mutation and the appends are plain in-process
+        list/dict operations under one lock — there is no failure path
+        between the first and last event."""
+        t = self._table(app_id, channel_id, create=True)
+        out = []
+        with self._lock:
+            for event in events:
+                e = event if event.event_id else event.with_id(uuid.uuid4().hex)
+                if e.event_id in t.by_id:
+                    self._remove_from_lists(t, e.event_id)
+                key = (e.event_time.timestamp(), t.seq)
+                t.seq += 1
+                pos = bisect.bisect_right(t.keys, key)
+                t.keys.insert(pos, key)
+                t.events.insert(pos, e)
+                t.by_id[e.event_id] = e
+                out.append(e.event_id)
+        return out
 
     @staticmethod
     def _remove_from_lists(t: _Table, event_id: str) -> None:

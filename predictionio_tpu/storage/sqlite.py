@@ -168,6 +168,8 @@ class SQLiteEvents(EventBackend):
             conn.commit()
         return e.event_id  # type: ignore[return-value]
 
+    BATCH_ATOMIC = True  # one executemany inside one transaction
+
     def insert_batch(
         self, events: Sequence[Event], app_id: int, channel_id: int | None = None
     ) -> list[str]:
@@ -179,10 +181,19 @@ class SQLiteEvents(EventBackend):
             for e in withids:
                 self._seq += 1
                 rows.append(self._row(e) + (self._seq,))
-            conn.executemany(
-                f"INSERT OR REPLACE INTO {table} VALUES (?,?,?,?,?,?,?,?,?,?,?,?)", rows
-            )
-            conn.commit()
+            try:
+                conn.executemany(
+                    f"INSERT OR REPLACE INTO {table} VALUES (?,?,?,?,?,?,?,?,?,?,?,?)", rows
+                )
+                conn.commit()
+            except sqlite3.Error as e:
+                # the BATCH_ATOMIC contract: a failure persists NOTHING.
+                # Without the rollback, rows already in the implicit
+                # transaction would ride out on the NEXT commit of this
+                # (thread-reused) connection; callers also only catch
+                # StorageError, not raw sqlite3 errors.
+                conn.rollback()
+                raise StorageError(f"batch insert failed: {e}") from e
         return [e.event_id for e in withids]  # type: ignore[misc]
 
     # -- point ops --------------------------------------------------------

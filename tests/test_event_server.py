@@ -9,6 +9,7 @@ import requests
 
 from predictionio_tpu.api import create_event_app
 from predictionio_tpu.storage import Storage
+from predictionio_tpu.storage.events_base import StorageError
 
 
 class _ServerThread:
@@ -268,3 +269,28 @@ def test_access_key_event_whitelist(server, app_key):
         f"{server.url}/events.json?accessKey={restricted.key}", json=EV
     )
     assert denied.status_code == 403
+
+
+def test_batch_atomicity_contract(server, app_key):
+    """Atomic backends take the one-call insert_batch fast path (a failure
+    reports 500 for all — nothing persisted); non-atomic backends insert
+    per event so statuses are exact and no double-ingest retry trap exists."""
+    import unittest.mock as mock
+
+    _, key = app_key
+    url = f"{server.url}/batch/events.json?accessKey={key}"
+    events_dao = Storage.get_events()
+    assert events_dao.BATCH_ATOMIC  # memory backend: one-call path
+
+    batch = [dict(EV, entityId=f"ub{i}") for i in range(3)]
+    with mock.patch.object(type(events_dao), "insert_batch",
+                           side_effect=StorageError("disk full")):
+        r = requests.post(url, json=batch)
+    assert [x["status"] for x in r.json()] == [500, 500, 500]
+
+    # non-atomic: the handler must NOT call insert_batch at all
+    with mock.patch.object(type(events_dao), "BATCH_ATOMIC", False), \
+         mock.patch.object(type(events_dao), "insert_batch",
+                           side_effect=AssertionError("fast path taken")):
+        r = requests.post(url, json=batch)
+    assert [x["status"] for x in r.json()] == [201, 201, 201]
