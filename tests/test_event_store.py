@@ -197,3 +197,37 @@ class TestHostSharding:
             store.find_frame("ShardApp", host_shard=(3, 1))
         with _pytest.raises(ValueError):
             store.find_frame("ShardApp", host_shard=(0, 0))
+
+
+def test_sqlite_batch_failure_persists_nothing(tmp_path):
+    """The BATCH_ATOMIC contract: a failing insert_batch rolls back the
+    open transaction and raises StorageError — the next commit on the
+    (reused) connection must not ride out a stranded partial batch."""
+    import unittest.mock as mock
+
+    from predictionio_tpu.storage.events_base import StorageError
+    from predictionio_tpu.storage.sqlite import SQLiteEvents
+
+    be = SQLiteEvents({"path": str(tmp_path / "atomic.db")})
+    be.init_app(APP)
+    assert be.BATCH_ATOMIC
+    batch = [mk(minutes=m) for m in range(4)]
+    # poison the LAST row (wrong arity) so executemany fails after earlier
+    # rows entered the transaction — the interesting partial-failure case
+    real_row = type(be)._row
+    rows_built = []
+
+    def poisoned(self, e):
+        rows_built.append(e)
+        if len(rows_built) == 4:
+            return ("bad",)
+        return real_row(self, e)
+
+    with mock.patch.object(type(be), "_row", poisoned), \
+         pytest.raises(StorageError):
+        be.insert_batch(batch, APP)
+    # a later single insert commits — it must not flush stranded rows
+    be.insert(mk(minutes=99), APP)
+    evs = list(be.find(EventQuery(APP)))
+    assert len(evs) == 1
+    be.close()
