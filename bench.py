@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -431,6 +432,35 @@ def enable_compile_cache() -> None:
     jax.config.update("jax_compilation_cache_dir", _cache_dir())
 
 
+def device_healthy(timeout_s: int = 180) -> bool:
+    """Probe the default accelerator in a SUBPROCESS with a hard timeout.
+    The remote-tunneled platform can wedge such that any jax op blocks
+    forever — probing in-process would hang the whole bench (observed:
+    a multi-hour platform outage mid-round). The probe child is
+    disposable; only its exit code matters."""
+    code = ("import jax, numpy as np\n"
+            "x = jax.jit(lambda a: a + 1)(np.ones(8))\n"
+            "assert float(np.asarray(x)[0]) == 2.0\n"
+            "print('HEALTHY', jax.default_backend(), "
+            "jax.devices()[0].platform)\n")
+    try:
+        out = subprocess.run([sys.executable, "-c", code],
+                             capture_output=True, text=True,
+                             timeout=timeout_s,
+                             cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return False
+    if out.returncode != 0:
+        return False
+    for line in out.stdout.splitlines():
+        if line.startswith("HEALTHY "):
+            # a silently CPU-defaulted jax also answers the probe — only
+            # an actual accelerator counts as healthy, else a full-scale
+            # bf16 run would execute emulated on host mislabeled "tpu"
+            return "tpu" in line.split()[1:]
+    return False
+
+
 def cpu_floor() -> float:
     """Measure the CPU floor in a subprocess (fresh jax platform), scaled
     linearly from the subsample to full size."""
@@ -446,7 +476,13 @@ def cpu_floor() -> float:
         "r = {k: v for k, v in r.items() if k in ('iters_per_sec', 'n_ratings')}\n"
         "print('FLOOR ' + json.dumps(r))\n"
     )
-    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.path.dirname(os.path.abspath(__file__)))
+    # single-device floor by convention: the cpu-fallback mode forces an
+    # 8-device flag into the parent env that must not leak into the child
+    env["XLA_FLAGS"] = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        env.get("XLA_FLAGS", "")).strip()
     out = subprocess.run(
         [sys.executable, "-c", code],
         capture_output=True, text=True, env=env, timeout=1800,
@@ -502,31 +538,69 @@ def main() -> None:
     # accumulation + f32 solve); the CPU floor stays f32 — each substrate
     # runs its natural best configuration. The accuracy gate above ties
     # the fast config's model quality to the exact solver's.
+    platform = "tpu"
+    for attempt in range(4):
+        if device_healthy():
+            break
+        log(f"accelerator probe failed (attempt {attempt + 1}/4)")
+        if attempt < 3:
+            log("retrying in 300s")
+            time.sleep(300)
+    else:
+        # the artifact must not be empty OR a silent hang: run the whole
+        # bench on the virtual CPU mesh at reduced scale, clearly labeled
+        log("accelerator unreachable — falling back to a LABELED CPU run "
+            "(virtual 8-device mesh, reduced scale); the value below is "
+            "NOT a TPU number")
+        platform = "cpu-fallback"
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                                   + " --xla_force_host_platform_device_count=8").strip()
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     enable_compile_cache()
     gap = accuracy_gate()
-    result = run_bench(N_RATINGS, TIMED_ITERS, "chip", compute_dtype="bfloat16")
+    n_timed = N_RATINGS if platform == "tpu" else CPU_SUBSAMPLE
+    # bf16 is EMULATED on CPU (an order of magnitude slower than f32
+    # there); each substrate runs its natural best configuration
+    cdt = "bfloat16" if platform == "tpu" else "float32"
+    result = run_bench(n_timed, TIMED_ITERS, "chip", compute_dtype=cdt)
     value = result["iters_per_sec"]
+    if platform != "tpu":
+        # scale the subsample wall rate to the full-size equivalent so the
+        # number is at least comparable to the cpu floor's convention
+        value *= n_timed / N_RATINGS
     extras: dict = {}
-    for name, fn in (
-        ("predict latency", lambda: predict_latency(result["u"], result["v"])),
-        ("catalog-1M latency", catalog_1m_latency),
+    sections: list = [
         ("factor sharding", factor_sharding_bench),
         ("event ingest", event_ingest_throughput),
-    ):
+    ]
+    if platform == "tpu":
+        # serving latency and the e2e child need the real accelerator
+        # (interpret-mode retrieval kernels are no latency statement, and
+        # the quickstart subprocess would hang on a wedged platform)
+        sections = [
+            ("predict latency",
+             lambda: predict_latency(result["u"], result["v"])),
+            ("catalog-1M latency", catalog_1m_latency),
+        ] + sections
+    for name, fn in sections:
         try:
             extras.update(fn())
         except Exception as e:  # noqa: BLE001 — secondary, not load-bearing
             log(f"{name} unavailable: {e}")
-    try:
-        import tempfile
+    if platform == "tpu":
+        try:
+            import tempfile
 
-        with tempfile.TemporaryDirectory(prefix="pio_e2e_cache_") as cd:
-            extras["e2e_train_deploy_cold_s"] = round(
-                e2e_quickstart("cold", cd), 1)
-            extras["e2e_train_deploy_s"] = round(
-                e2e_quickstart("warm cache", cd), 1)
-    except Exception as e:  # noqa: BLE001
-        log(f"e2e quickstart unavailable: {e}")
+            with tempfile.TemporaryDirectory(prefix="pio_e2e_cache_") as cd:
+                extras["e2e_train_deploy_cold_s"] = round(
+                    e2e_quickstart("cold", cd), 1)
+                extras["e2e_train_deploy_s"] = round(
+                    e2e_quickstart("warm cache", cd), 1)
+        except Exception as e:  # noqa: BLE001
+            log(f"e2e quickstart unavailable: {e}")
     try:
         floor = cpu_floor()
         log(f"cpu floor (scaled to 20M): {floor:.4f} iters/sec")
@@ -539,7 +613,8 @@ def main() -> None:
         "value": round(value, 3),
         "unit": "iters/sec/chip",
         "vs_baseline": round(vs, 2),
-        "config": {"compute_dtype": "bfloat16", "solver": "cg",
+        "config": {"compute_dtype": cdt, "solver": "cg",
+                   "platform": platform,
                    "accuracy_gap_rmse": round(gap, 6),
                    "floor_config": "float32/cg", **extras},
     }))

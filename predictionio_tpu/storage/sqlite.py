@@ -153,7 +153,7 @@ class SQLiteEvents(EventBackend):
             e.target_entity_id,
             e.properties.to_json(),
             e.event_time.timestamp(),
-            json.dumps(list(e.tags)),
+            "[]" if not e.tags else json.dumps(list(e.tags)),
             e.pr_id,
             e.creation_time.timestamp(),
         )
