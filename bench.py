@@ -497,11 +497,11 @@ def cpu_floor() -> float:
     raise RuntimeError(f"cpu floor failed: {out.stdout[-500:]} {out.stderr[-500:]}")
 
 
-def accuracy_gate() -> float:
-    """The timed config (bf16 + inexact CG) must match the exact f32
-    solver's model quality before its speed counts: train twice on a
-    200k-rating subsample and compare reconstruction RMSE over observed
-    entries. Returns the RMSE gap; raises if it exceeds 1e-3."""
+def accuracy_gate(compute_dtype: str = "bfloat16") -> float:
+    """The timed config (inexact CG at ``compute_dtype``) must match the
+    exact f32 solver's model quality before its speed counts: train twice
+    on a 200k-rating subsample and compare reconstruction RMSE over
+    observed entries. Returns the RMSE gap; raises if it exceeds 1e-3."""
     import jax.numpy as jnp
 
     from predictionio_tpu.models.als import ALSConfig, train_als
@@ -524,12 +524,13 @@ def accuracy_gate() -> float:
     exact = rmse(train_als(r, ALSConfig(**base, solver="cholesky",
                                         compute_dtype="float32")))
     fast = rmse(train_als(r, ALSConfig(**base, solver="cg",
-                                       compute_dtype="bfloat16")))
+                                       compute_dtype=compute_dtype)))
     gap = abs(fast - exact)
-    log(f"accuracy gate: exact-f32 RMSE {exact:.5f}, cg-bf16 RMSE {fast:.5f}, "
-        f"gap {gap:.2e}")
+    log(f"accuracy gate: exact-f32 RMSE {exact:.5f}, cg-{compute_dtype} "
+        f"RMSE {fast:.5f}, gap {gap:.2e}")
     if gap > 1e-3:
-        raise AssertionError(f"cg/bf16 accuracy gap {gap:.2e} > 1e-3")
+        raise AssertionError(
+            f"cg/{compute_dtype} accuracy gap {gap:.2e} > 1e-3")
     return gap
 
 
@@ -553,18 +554,19 @@ def main() -> None:
             "(virtual 8-device mesh, reduced scale); the value below is "
             "NOT a TPU number")
         platform = "cpu-fallback"
-        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
-                                   + " --xla_force_host_platform_device_count=8").strip()
-        os.environ["JAX_PLATFORMS"] = "cpu"
         import jax
 
+        # config, not env: children (floor, sharding, ingest) must not
+        # inherit a virtual-device flag meant for this process only
         jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", 8)
     enable_compile_cache()
-    gap = accuracy_gate()
-    n_timed = N_RATINGS if platform == "tpu" else CPU_SUBSAMPLE
     # bf16 is EMULATED on CPU (an order of magnitude slower than f32
-    # there); each substrate runs its natural best configuration
+    # there); each substrate runs its natural best configuration, and the
+    # gate validates the SAME config the timed run uses
     cdt = "bfloat16" if platform == "tpu" else "float32"
+    gap = accuracy_gate(compute_dtype=cdt)
+    n_timed = N_RATINGS if platform == "tpu" else CPU_SUBSAMPLE
     result = run_bench(n_timed, TIMED_ITERS, "chip", compute_dtype=cdt)
     value = result["iters_per_sec"]
     if platform != "tpu":
