@@ -298,6 +298,29 @@ def cmd_eval(args) -> int:
     engine_dir = Path(args.engine_dir)
     ev_obj = resolve_attr(args.evaluation, engine_dir=engine_dir)
     evaluation = ev_obj() if isinstance(ev_obj, type) else ev_obj
+    if args.fast:
+        # rebuild the evaluation's engine as a FastEvalEngine: identical
+        # components, but pipeline prefixes (datasource folds, prepared
+        # data, trained models) memoize across grid variants — the
+        # reference requires subclassing FastEvalEngine in code
+        # (FastEvalEngine.scala:297); here it is one flag
+        from ..controller.engine import Engine
+        from ..controller.fast_eval import FastEvalEngine
+
+        e = evaluation.engine
+        if type(e) is not Engine:
+            # a custom Engine subclass may override eval()/batch_eval();
+            # rebuilding from the class maps alone would silently drop
+            # that behavior — refuse rather than change results
+            _die(f"--fast requires a plain Engine; {type(e).__name__} "
+                 "overrides engine behavior (wrap it in FastEvalEngine "
+                 "in code instead)")
+        evaluation.engine = FastEvalEngine(
+            data_source_classes=e.data_source_classes,
+            preparator_classes=e.preparator_classes,
+            algorithm_classes=e.algorithm_classes,
+            serving_classes=e.serving_classes,
+        )
     if args.engine_params_generator:
         gen_obj = resolve_attr(args.engine_params_generator,
                                engine_dir=engine_dir)
@@ -317,6 +340,9 @@ def cmd_eval(args) -> int:
         best_json_path=str(engine_dir / "best.json"),
     )
     _ok(result.pretty_print())
+    if args.fast:
+        hits = dict(evaluation.engine.hit_counts)
+        _ok(f"FastEval prefix cache hits: {hits or 'none'}")
     _ok(f"Evaluation completed. Instance: {iid}; best params -> best.json")
     return 0
 
@@ -518,6 +544,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("engine_params_generator", nargs="?",
                     help="module:EngineParamsGenerator")
     sp.add_argument("--batch", default="")
+    sp.add_argument("--fast", action="store_true",
+                    help="memoize pipeline prefixes across grid variants "
+                         "(FastEvalEngine)")
 
     sp = sub.add_parser("deploy")
     _add_engine_args(sp)

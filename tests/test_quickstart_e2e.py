@@ -185,3 +185,46 @@ class MyGrid(EngineParamsGenerator):
     out = capsys.readouterr().out
     assert "leaderboard" in out
     assert (engine_dir / "best.json").exists()
+
+
+def test_eval_fast_flag(engine_dir, tmp_path, rng, capsys):
+    """`pio eval --fast` rebuilds the evaluation's engine as a
+    FastEvalEngine: same leaderboard, pipeline prefixes memoized across
+    the grid (the reference needs a code change for this;
+    FastEvalEngine.scala:297)."""
+    assert pio(["app", "new", "qtest"]) == 0
+    app = Storage.get_metadata().app_get_by_name("qtest")
+    events_file = tmp_path / "events.jsonl"
+    make_events_file(events_file, rng, nu=20, ni=12)
+    assert pio(["import", "--appid", str(app.id), "--input", str(events_file)]) == 0
+    (engine_dir / "evaluation.py").write_text('''
+from dataclasses import dataclass
+from predictionio_tpu.controller import (AverageMetric, EngineParams,
+                                         Evaluation)
+from engine import DataSourceParams, AlgorithmParams, engine_factory
+
+class Hit(AverageMetric):
+    def calculate_qpa(self, q, p, a):
+        return 1.0 if any(s.item == a["item"] for s in p.itemScores) else 0.0
+
+class MyEval(Evaluation):
+    engine = engine_factory()
+    metric = Hit()
+    engine_params_list = [
+        EngineParams(
+            data_source_params=("", DataSourceParams(app_name="qtest", eval_k=2)),
+            algorithm_params_list=(("als", AlgorithmParams(rank=r, num_iterations=4)),),
+        )
+        for r in (2, 4)
+    ]
+''')
+    assert pio(["eval", "--fast", "--engine-dir", str(engine_dir),
+                "evaluation:MyEval"]) == 0
+    out = capsys.readouterr().out
+    assert "leaderboard" in out
+    # both variants share datasource+preparator params: the second variant
+    # must have hit the memoized prefixes (reported by the CLI)
+    assert "FastEval prefix cache hits" in out
+    # the longest shared prefix (datasource+preparator) hits once for the
+    # second variant — shorter-prefix hits are subsumed by it
+    assert "'preparator': 1" in out
