@@ -61,13 +61,24 @@ __all__ = ["ALSModel", "ALSConfig", "train_als"]
 #: normal equations (dense VᵀV + plain-λ ridge) are worse conditioned
 #: AND less diagonal — Jacobi helps less — so it runs deeper.
 DEFAULT_CG_ITERS = 8
+#: warm-started explicit solves (the training sweep seeds each inner
+#: solve with the row's previous factors, leaving CG only the sweep's
+#: delta) converge in fewer iterations: measured on the bench accuracy
+#: gate, warm depth 5 lands closer to the exact solver than cold depth
+#: 8 — a ~1/3 cut of the solve phase's gramian re-read traffic. Cold
+#: solves (no x0) keep DEFAULT_CG_ITERS.
+DEFAULT_CG_ITERS_WARM = 5
 DEFAULT_CG_ITERS_IMPLICIT = 16
 
 
-def _resolve_cg_iters(cg_iters, implicit: bool) -> int:
+def _resolve_cg_iters(cg_iters, implicit: bool, *, warm: bool = False) -> int:
     if cg_iters is not None:
         return cg_iters
-    return DEFAULT_CG_ITERS_IMPLICIT if implicit else DEFAULT_CG_ITERS
+    if implicit:
+        # implicit normal equations are worse conditioned and less
+        # diagonal (Jacobi helps less) — no measured warm shortcut
+        return DEFAULT_CG_ITERS_IMPLICIT
+    return DEFAULT_CG_ITERS_WARM if warm else DEFAULT_CG_ITERS
 
 
 @dataclasses.dataclass(frozen=True)
@@ -237,9 +248,10 @@ def _spd_solve(a, b, *, solver="cg", cg_iters=DEFAULT_CG_ITERS,
     factors move less and less between sweeps, so seeding each inner
     solve with the row's previous factors leaves CG only the sweep's
     *delta* to resolve — measured on the bench gate, warm-started depth
-    5 lands closer to the exact solver than cold depth 8, while cutting
-    the solve phase's dominant gramian re-read traffic ~1/3 (the seed
-    costs one extra matvec for the initial residual r0 = b - A·x0).
+    5 (DEFAULT_CG_ITERS_WARM, what the training sweep resolves to) lands
+    closer to the exact solver than cold depth 8, cutting the solve
+    phase's dominant gramian re-read traffic ~1/3 net of the one extra
+    matvec the seed costs (initial residual r0 = b - A·x0).
 
     The CG path is JACOBI-PRECONDITIONED: z = r / diag(A). The ridge-set
     gramians' diagonals span the degree skew (λ·n_u ranges over 4 decades
@@ -613,11 +625,10 @@ def make_train_step(mesh, u_layout, i_layout, *, rank, lambda_=0.1,
 
     row_ax = "model" if model_sharded else None
     fac = NamedSharding(mesh, P(row_ax, None))
+    warm = solver == "cg"
     kw = dict(lambda_=lambda_, implicit=implicit, alpha=alpha, rank=rank,
               compute_dtype=compute_dtype, solver=solver,
-              cg_iters=_resolve_cg_iters(cg_iters, implicit))
-
-    warm = kw["solver"] == "cg"
+              cg_iters=_resolve_cg_iters(cg_iters, implicit, warm=warm))
 
     def step(u_buckets, i_buckets, u_prev, v):
         u = _solve_side(u_buckets, u_layout, v, kw=kw,
@@ -749,21 +760,34 @@ def train_als(ratings: Ratings, config: ALSConfig, mesh=None, *,
             / np.sqrt(rank), i_lay)
         # the user side starts from the same init scheme purely as the
         # first sweep's CG warm-start seed (the first half-step solves u
-        # from v, so u's init never enters the math beyond that seed)
-        u_restored = _to_slots(
+        # from v, so u's init never enters the math beyond that seed).
+        # Kept separate from u_restored: a seed is not a trained factor,
+        # and the iterations==0 fallback below must not return it.
+        u_seed = _to_slots(
             np.abs(np.asarray(jax.random.normal(k_u, (nu, rank),
                                                 dtype=jnp.float32)))
             / np.sqrt(rank), u_lay)
+    else:
+        u_seed = None
 
+    # the warm-start depth (DEFAULT_CG_ITERS_WARM) presumes alternation
+    # corrects the shallower inner solves — true from the accuracy-gated
+    # 3-iteration config up; for 1-2 iteration runs the first sweep's
+    # "warm" seed is still the random init and nothing corrects after it,
+    # so those keep the cold depth
+    cg_iters = config.cg_iters
+    if (cg_iters is None and config.solver == "cg"
+            and not config.implicit_prefs and config.iterations < 3):
+        cg_iters = DEFAULT_CG_ITERS
     step = make_train_step(
         mesh, u_lay, i_lay, rank=rank, lambda_=config.lambda_,
         implicit=config.implicit_prefs, alpha=config.alpha,
         model_sharded=model_sharded,
         compute_dtype=config.compute_dtype, solver=config.solver,
-        cg_iters=config.cg_iters,
+        cg_iters=cg_iters,
     )
     u = None
-    carry_u = u_restored
+    carry_u = u_restored if u_restored is not None else u_seed
     for it in range(start_it, config.iterations):
         u, v = step(u_bk, i_bk, carry_u, v)
         carry_u = u
