@@ -371,6 +371,7 @@ def cmd_deploy(args) -> int:
         access_key=args.accesskey,
         batch_window_ms=args.batch_window_ms,
         batch_max=args.batch_max,
+        batch_inflight=args.batch_inflight,
         engine_dir=engine_dir,
     )
     return 0
@@ -561,6 +562,9 @@ def build_parser() -> argparse.ArgumentParser:
                          "(0 disables batching)")
     sp.add_argument("--batch-max", type=int, default=64,
                     help="max queries per micro-batch")
+    sp.add_argument("--batch-inflight", type=int, default=8,
+                    help="max micro-batches dispatched concurrently "
+                         "(pipelines the per-call dispatch round trip)")
 
     sp = sub.add_parser("undeploy")
     sp.add_argument("--ip", default="localhost")

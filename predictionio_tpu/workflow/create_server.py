@@ -101,6 +101,7 @@ class EngineServer:
         access_key: str | None = None,
         batch_window_ms: float = 1.0,
         batch_max: int = 64,
+        batch_inflight: int = 8,
         engine_dir=None,
     ):
         self.engine = engine
@@ -132,6 +133,7 @@ class EngineServer:
             self.batcher = MicroBatcher(
                 self.serve_query_batch,
                 max_batch=batch_max, window_s=batch_window_ms / 1000.0,
+                max_inflight=batch_inflight,
             )
 
     # -- query hot path ----------------------------------------------------

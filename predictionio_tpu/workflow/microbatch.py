@@ -6,7 +6,7 @@ into ``algorithms.map(_.predictBase(...))``); per-query dispatch is fine
 on a JVM, but on a TPU each device call has a fixed launch overhead and
 the fused retrieval kernel (ops/retrieval.py) amortizes it over a query
 batch. This dispatcher coalesces concurrent ``/queries.json`` requests
-into one batched serve call:
+into batched serve calls:
 
 - first arrival opens a window (default 1 ms); everything arriving within
   it (up to ``max_batch``) is served as ONE batch;
@@ -15,8 +15,20 @@ into one batched serve call:
 - an idle server adds at most the window to p50; a loaded server turns N
   device calls into ceil(N/max_batch).
 
+Batches are PIPELINED: up to ``max_inflight`` batches may be dispatched
+concurrently. On the tunneled TPU platform a device call costs ~65 ms of
+dispatch round trip around ~1.3 ms of device time (docs/PERF_NOTES.md),
+so a single-worker loop leaves the chip >97% idle — batch N+1 must go out
+while batch N's round trip is still in the air. Batch FORMATION stays on
+one loop (arrival order and the window are preserved, so single-query p50
+is unchanged); only the serve calls overlap, bounded by a semaphore.
+Completions may land out of order; each query's future resolves
+individually, so callers never observe reordering.
+
 The batch function contract: ``batch_fn(list[query]) -> list[("ok",
-result) | ("err", exception)]``, run in a worker thread.
+result) | ("err", exception)]``, run in a worker thread; it must be
+thread-safe up to ``max_inflight`` concurrent calls (the engine-server
+batch path is: stats under a lock, deployed bundle read via snapshot).
 """
 
 from __future__ import annotations
@@ -38,7 +50,7 @@ class ServerBusy(RuntimeError):
 
 
 class MicroBatcher:
-    """Coalesces concurrent submissions into batched calls."""
+    """Coalesces concurrent submissions into pipelined batched calls."""
 
     def __init__(
         self,
@@ -47,27 +59,40 @@ class MicroBatcher:
         max_batch: int = 64,
         window_s: float = 0.001,
         max_pending: int = 1024,
+        max_inflight: int = 8,
     ):
         self.batch_fn = batch_fn
         self.max_batch = max(1, max_batch)
         self.window_s = max(0.0, window_s)
         self.max_pending = max(1, max_pending)
+        self.max_inflight = max(1, max_inflight)
         self._pending: list[tuple[Any, asyncio.Future]] = []
         self._wake: asyncio.Event | None = None
         self._task: asyncio.Task | None = None
-        # observability: how well batching is working
+        self._sem: asyncio.Semaphore | None = None
+        self._inflight: set[asyncio.Task] = set()
+        self._live = 0  # dispatches currently holding a semaphore slot
+        self._closing = False
+        # observability: how well batching + pipelining are working
         self.batches = 0
         self.batched_queries = 0
         self.max_seen_batch = 0
+        self.peak_inflight = 0
 
     def _ensure_started(self) -> None:
         if self._task is None or self._task.done():
             self._wake = asyncio.Event()
+            self._sem = asyncio.Semaphore(self.max_inflight)
             self._task = asyncio.create_task(self._run())
 
     async def submit(self, query: Any) -> Any:
         """Enqueue one query; resolves to its result (or raises its own
         error) when its batch completes. Raises ServerBusy at capacity."""
+        if self._closing:
+            # close() is mid-drain: starting a fresh worker generation now
+            # would either leak it or have close() cancel this future —
+            # shed instead (the HTTP layer answers 503)
+            raise ServerBusy("micro-batcher is shutting down")
         if len(self._pending) >= self.max_pending:
             raise ServerBusy(
                 f"micro-batch queue full ({self.max_pending} pending)")
@@ -79,33 +104,64 @@ class MicroBatcher:
         return await fut
 
     async def close(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except (asyncio.CancelledError, Exception):  # noqa: BLE001
-                pass
-            self._task = None
-        # fail anything still queued — a caller awaiting submit() must not
-        # hang forever because shutdown won the race with its batch
-        pending, self._pending = self._pending, []
-        for _, fut in pending:
-            if not fut.done():
-                fut.set_exception(asyncio.CancelledError("batcher closed"))
+        self._closing = True  # submit() sheds until the drain finishes
+        try:
+            if self._task is not None:
+                self._task.cancel()
+                try:
+                    await self._task
+                except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                    pass
+                self._task = None
+            # let dispatched batches finish — their queries already left
+            # the queue and their callers are awaiting results; to_thread
+            # work cannot be interrupted anyway
+            inflight, self._inflight = set(self._inflight), set()
+            if inflight:
+                await asyncio.gather(*inflight, return_exceptions=True)
+            # fail anything still queued — a caller awaiting submit() must
+            # not hang forever because shutdown won the race with its batch
+            pending, self._pending = self._pending, []
+            for _, fut in pending:
+                if not fut.done():
+                    fut.set_exception(asyncio.CancelledError("batcher closed"))
+        finally:
+            self._closing = False  # a later submit() may restart cleanly
 
     async def _run(self) -> None:
-        assert self._wake is not None
+        """Batch-formation loop: serializes windowing + arrival order,
+        hands each formed batch to a concurrent dispatch task."""
+        assert self._wake is not None and self._sem is not None
         while True:
             await self._wake.wait()
             if self.window_s > 0 and len(self._pending) < self.max_batch:
                 # window open: let concurrent requests pile in
                 await asyncio.sleep(self.window_s)
+            # bound in-flight BEFORE taking queries off the queue, so a
+            # saturated pipeline backpressures into max_pending/503 land
+            # instead of stripping the queue into waiting tasks
+            await self._sem.acquire()
             batch = self._pending[: self.max_batch]
             del self._pending[: len(batch)]
             if not self._pending:
                 self._wake.clear()
             if not batch:
+                self._sem.release()
                 continue
+            # hand THIS generation's semaphore to the dispatch: a restart
+            # replaces self._sem, and a straddling dispatch must release
+            # the slot it actually acquired, not the new generation's
+            task = asyncio.create_task(self._dispatch(batch, self._sem))
+            self._inflight.add(task)
+            task.add_done_callback(self._inflight.discard)
+
+    async def _dispatch(self, batch: list[tuple[Any, asyncio.Future]],
+                        sem: asyncio.Semaphore) -> None:
+        """Serve ONE formed batch; owns (and releases) one slot of the
+        semaphore it was formed under."""
+        self._live += 1
+        self.peak_inflight = max(self.peak_inflight, self._live)
+        try:
             queries = [q for q, _ in batch]
             try:
                 outcomes = await asyncio.to_thread(self.batch_fn, queries)
@@ -117,7 +173,7 @@ class MicroBatcher:
                 for _, fut in batch:
                     if not fut.done():
                         fut.set_exception(e)
-                continue
+                return
             self.batches += 1
             self.batched_queries += len(batch)
             self.max_seen_batch = max(self.max_seen_batch, len(batch))
@@ -128,6 +184,9 @@ class MicroBatcher:
                     fut.set_result(payload)
                 else:
                     fut.set_exception(payload)
+        finally:
+            self._live -= 1
+            sem.release()
 
     def stats(self) -> dict:
         return {
@@ -135,4 +194,6 @@ class MicroBatcher:
             "batchedQueries": self.batched_queries,
             "avgBatchSize": (self.batched_queries / self.batches) if self.batches else 0.0,
             "maxBatchSize": self.max_seen_batch,
+            "maxInflight": self.max_inflight,
+            "peakInflight": self.peak_inflight,
         }

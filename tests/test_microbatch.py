@@ -95,6 +95,151 @@ class TestMicroBatcher:
         assert s["batchedQueries"] == 8
         assert s["avgBatchSize"] >= 1.0
 
+    def test_pipelines_batches_concurrently(self):
+        """With a slow batch_fn (simulating the ~65 ms dispatch round
+        trip) and max_inflight > 1, batch N+1 must dispatch while batch N
+        is still in the air — wall clock ~= ceil(B / inflight) * RTT, not
+        B * RTT."""
+        import threading
+        import time
+
+        live = 0
+        peak = 0
+        lock = threading.Lock()
+
+        def slow_batch(queries):
+            nonlocal live, peak
+            with lock:
+                live += 1
+                peak = max(peak, live)
+            time.sleep(0.05)  # the "round trip"
+            with lock:
+                live -= 1
+            return [("ok", q) for q in queries]
+
+        async def main():
+            mb = MicroBatcher(slow_batch, max_batch=2, window_s=0.0,
+                              max_inflight=4)
+            t0 = time.perf_counter()
+            out = await asyncio.gather(*[mb.submit(i) for i in range(16)])
+            dt = time.perf_counter() - t0
+            await mb.close()
+            return out, dt
+
+        out, dt = run(main())
+        assert out == list(range(16))
+        # 8 batches of 2 at 50 ms each: serial ~0.4 s, 4-deep pipeline ~0.1 s
+        assert peak >= 3, f"batches never overlapped (peak inflight {peak})"
+        assert dt < 0.3, f"pipelining did not cut wall time ({dt:.3f}s)"
+
+    def test_inflight_bounded(self):
+        """No more than max_inflight batch_fn calls run at once."""
+        import threading
+        import time
+
+        live = 0
+        peak = 0
+        lock = threading.Lock()
+
+        def slow_batch(queries):
+            nonlocal live, peak
+            with lock:
+                live += 1
+                peak = max(peak, live)
+            time.sleep(0.02)
+            with lock:
+                live -= 1
+            return [("ok", q) for q in queries]
+
+        async def main():
+            mb = MicroBatcher(slow_batch, max_batch=1, window_s=0.0,
+                              max_inflight=2)
+            await asyncio.gather(*[mb.submit(i) for i in range(10)])
+            await mb.close()
+
+        run(main())
+        assert peak <= 2, f"inflight bound violated (peak {peak})"
+
+    def test_out_of_order_completion_resolves_correct_futures(self):
+        """Batch completions landing out of order must still resolve each
+        query's own future (and per-query isolation must hold across
+        concurrent batches)."""
+        import time
+
+        def batch_fn(queries):
+            # later batches (higher values) finish FIRST
+            time.sleep(0.08 - 0.02 * (queries[0] // 2))
+            return [("err", ValueError(str(q))) if q == 5 else ("ok", q * 10)
+                    for q in queries]
+
+        async def main():
+            mb = MicroBatcher(batch_fn, max_batch=2, window_s=0.0,
+                              max_inflight=4)
+            return await asyncio.gather(
+                *[mb.submit(i) for i in range(8)], return_exceptions=True)
+
+        out = run(main())
+        assert isinstance(out[5], ValueError) and str(out[5]) == "5"
+        assert [o for i, o in enumerate(out) if i != 5] == \
+            [i * 10 for i in range(8) if i != 5]
+
+    def test_submit_during_close_sheds_not_resurrects(self):
+        """A submit() racing a mid-drain close() must raise ServerBusy —
+        not resurrect a fresh worker generation that close() then cancels
+        (or leaks)."""
+        import threading
+
+        from predictionio_tpu.workflow.microbatch import ServerBusy
+
+        release = threading.Event()
+
+        def slow_batch(queries):
+            release.wait(2)
+            return [("ok", q) for q in queries]
+
+        async def main():
+            mb = MicroBatcher(slow_batch, max_batch=4, window_s=0.0)
+            t = asyncio.create_task(mb.submit(1))
+            while not mb._inflight:
+                await asyncio.sleep(0.005)
+            closer = asyncio.create_task(mb.close())
+            await asyncio.sleep(0.02)  # close() is awaiting the in-flight
+            with __import__("pytest").raises(ServerBusy):
+                await mb.submit(2)
+            release.set()
+            await closer
+            assert await t == 1
+            # after close completes, the batcher is restartable
+            assert await mb.submit(3) == 3
+            await mb.close()
+
+        run(main())
+
+    def test_close_waits_for_inflight(self):
+        """close() must let already-dispatched batches resolve their
+        futures (their queries left the queue; callers are awaiting)."""
+        import threading
+        import time
+
+        release = threading.Event()
+
+        def slow_batch(queries):
+            release.wait(2)
+            return [("ok", q) for q in queries]
+
+        async def main():
+            mb = MicroBatcher(slow_batch, max_batch=4, window_s=0.0)
+            t = asyncio.create_task(mb.submit(7))
+            while not mb._inflight:  # dispatched, now in the air
+                await asyncio.sleep(0.005)
+            closer = asyncio.create_task(mb.close())
+            await asyncio.sleep(0.02)
+            release.set()
+            await closer
+            return await t
+
+        assert run(main()) == 7
+
 
 class TestBatchedServing:
     """serve_query_batch against the real recommendation template."""
