@@ -21,11 +21,14 @@ Design (ALX-style, arxiv 2112.02194 — see PAPERS.md):
   rows/s on v5e vs ~470M rows/s for gathers).
 - Rows heavier than ``chunk_cap`` ride a dedicated tier as balanced
   chunks whose partial equations segment-sum per owner row.
-- Rows within a block shard over the mesh's ``data`` axis; the opposite
-  factor matrix is replicated (or row-sharded over ``model`` with
-  ``model_sharded``), so the only collective XLA inserts is the
-  all-gather of freshly-updated factors between half-steps — that is the
-  ICI traffic, replacing MLlib's factor-block shuffle.
+- Rows within a block shard over every mesh axis (data AND model — the
+  gramian phase consumes replicated factors, so block work parallelizes
+  over all devices); the opposite factor matrix is replicated (or
+  row-sharded over ``model`` with ``model_sharded``, explicitly
+  re-replicated once per half-step), so the only collective in the
+  compiled step is the all-gather of freshly-updated factors between
+  half-steps — that is the ICI traffic, replacing MLlib's factor-block
+  shuffle (pinned by test_als.test_model_sharded_collective_inventory).
 - Implicit feedback (Hu-Koren-Volinsky): per-entry confidence
   c = 1 + alpha·r with the VᵀV gramian trick; gramian is one einsum
   (psum'd over shards by XLA when V is sharded).
@@ -457,8 +460,9 @@ def _half_step(ids, vals, other, *, lambda_, implicit, alpha, rank,
 
 
 def put_layout(layout, mesh, *, vals_dtype=None):
-    """Device-put one side of the permuted layout: neighbor blocks sharded
-    over the data axis, chunk segment ids replicated. No mask upload —
+    """Device-put one side of the permuted layout: neighbor block rows
+    sharded over the data AND model axes combined, chunk segment ids
+    replicated. No mask upload —
     validity is encoded in vals, and padded ids point at the other side's
     zero slot (ops/neighbors.py). ``vals_dtype=bfloat16`` halves the
     ratings' transfer + HBM footprint (exact for half-star ratings;
@@ -473,7 +477,15 @@ def put_layout(layout, mesh, *, vals_dtype=None):
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    blk = NamedSharding(mesh, P(None, "data", None))
+    # block rows shard over EVERY mesh axis, not just "data": the gramian
+    # phase consumes replicated opposite factors, so its work parallelizes
+    # over all devices regardless of how the factor MATRICES are sharded.
+    # With only "data" here, a (4,2) data x model mesh would compute every
+    # block twice (the model pair replicates the gather+einsum — measured
+    # 2x slower than 8x1 on the gather-dominated step, BENCH_r03); the
+    # model axis must carry block work too.
+    row_axes = tuple(a for a in ("data", "model") if a in mesh.axis_names)
+    blk = NamedSharding(mesh, P(None, row_axes or None, None))
     rep = NamedSharding(mesh, P())
     multi = jax.process_count() > 1
 
@@ -511,10 +523,14 @@ def _process_local_slice(arr, sharding):
     for dim, part in enumerate(sharding.spec):
         if part is None:
             continue
-        if sharding.mesh.shape[part] % pc or arr.shape[dim] % pc:
+        axes = part if isinstance(part, tuple) else (part,)
+        axis_size = 1
+        for a in axes:
+            axis_size *= sharding.mesh.shape[a]
+        if axis_size % pc or arr.shape[dim] % pc:
             raise ValueError(
                 f"dim {dim} (axis {part!r}) does not split evenly over "
-                f"{pc} processes: mesh axis {sharding.mesh.shape[part]}, "
+                f"{pc} processes: mesh axes {axis_size}, "
                 f"dim size {arr.shape[dim]}")
         step = arr.shape[dim] // pc
         sl = [slice(None)] * arr.ndim
@@ -616,25 +632,38 @@ def make_train_step(mesh, u_layout, i_layout, *, rank, lambda_=0.1,
     sweep's output reuses the previous sweep's buffers).
 
     ``model_sharded=True`` shards the factor matrices' rows over the mesh's
-    ``model`` axis (tensor-parallel factors, ALX-style); XLA inserts the
-    all-gathers that cross-shard gathers need. Neighbor blocks always
-    shard block rows over ``data``.
+    ``model`` axis (tensor-parallel factors, ALX-style); the opposite
+    factors are explicitly replicated once per half-step (one all-gather —
+    see the ``step`` body comment). Neighbor blocks shard block rows over
+    every mesh axis (``put_layout``), so the gramian phase parallelizes
+    over all devices regardless of factor-matrix sharding.
     """
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     row_ax = "model" if model_sharded else None
     fac = NamedSharding(mesh, P(row_ax, None))
+    rep = NamedSharding(mesh, P(None, None))
     warm = solver == "cg"
     kw = dict(lambda_=lambda_, implicit=implicit, alpha=alpha, rank=rank,
               compute_dtype=compute_dtype, solver=solver,
               cg_iters=_resolve_cg_iters(cg_iters, implicit, warm=warm))
 
     def step(u_buckets, i_buckets, u_prev, v):
-        u = _solve_side(u_buckets, u_layout, v, kw=kw,
+        # Replicate the opposite factors ONCE per half-step (one
+        # all-gather of [slots, R] — the module docstring's intended ICI
+        # traffic). Without the explicit constraint GSPMD lowers every
+        # per-tier row gather from the model-sharded operand as
+        # mask+all-reduce over the GATHERED block — traffic proportional
+        # to nnz_padded, per tier, inside lax.map (measured: the 4x2
+        # data x model mesh ran SLOWER than 8x1 data-only, BENCH_r03;
+        # verified by the HLO collective-inventory test in test_als.py).
+        v_full = jax.lax.with_sharding_constraint(v, rep) if model_sharded else v
+        u = _solve_side(u_buckets, u_layout, v_full, kw=kw,
                         x0=u_prev if warm else None)
         u = jax.lax.with_sharding_constraint(u, fac)
-        v_new = _solve_side(i_buckets, i_layout, u, kw=kw,
+        u_full = jax.lax.with_sharding_constraint(u, rep) if model_sharded else u
+        v_new = _solve_side(i_buckets, i_layout, u_full, kw=kw,
                             x0=v if warm else None)
         return u, v_new
 

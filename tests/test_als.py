@@ -317,6 +317,61 @@ def test_model_sharded_odd_sizes(rng, mesh8):
         m_ms.item_factors, m_rep.item_factors, rtol=2e-4, atol=2e-5)
 
 
+def test_model_sharded_collective_inventory(mesh8):
+    """The compiled model-sharded train step's communication story
+    (VERDICT r3 item 2): the ONLY factor-sized collectives are one
+    replication all-gather of the opposite factors per half-step (plus
+    the solve-output gathers) — no all-to-all, no reduce-scatter, and
+    crucially NO all-reduce: GSPMD's fallback for gathers from a
+    row-sharded operand is mask+all-reduce over the GATHERED block
+    (traffic ~ nnz_padded, per tier, inside lax.map), which is what made
+    the 4x2 mesh slower than 8x1 in BENCH_r03. Committed input shardings
+    matter — uncommitted inputs let propagation pick different parameter
+    placements with worse lowerings."""
+    import re
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from predictionio_tpu.models.als import make_train_step, put_layout
+    from predictionio_tpu.ops.neighbors import build_bilinear_layout
+    from predictionio_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh((4, 2), ("data", "model"))
+    rng = np.random.default_rng(0)
+    nu, ni, rank, n = 64, 48, 8, 800
+    rows = rng.integers(0, nu, n).astype(np.int64)
+    cols = rng.integers(0, ni, n).astype(np.int64)
+    vals = rng.random(n).astype(np.float32)
+    u_lay, i_lay = build_bilinear_layout(rows, cols, vals, nu, ni, align=2)
+    u_bk = put_layout(u_lay, mesh)
+    i_bk = put_layout(i_lay, mesh)
+    step = make_train_step(mesh, u_lay, i_lay, rank=rank, model_sharded=True)
+    fac = NamedSharding(mesh, P("model", None))
+    u0 = jax.device_put(np.zeros((u_lay.slots, rank), np.float32), fac)
+    v0 = jax.device_put(np.zeros((i_lay.slots, rank), np.float32), fac)
+    hlo = step.lower(u_bk, i_bk, u0, v0).compile().as_text()
+
+    def defs(op):
+        return re.findall(rf"%{op}[\w.-]* = (\S+)", hlo)
+
+    assert not defs("all-reduce"), \
+        f"gather lowered as mask+all-reduce again: {defs('all-reduce')}"
+    assert not defs("all-to-all")
+    assert not defs("reduce-scatter")
+    ags = defs("all-gather")
+    # 2 replication all-gathers (one per half-step) + up to 2 solve-output
+    # gathers; anything more means per-tier gathers crept back in
+    assert 2 <= len(ags) <= 4, f"unexpected all-gather inventory: {ags}"
+    # every all-gather is factor-matrix-sized ([slots, R] f32 = 4*slots*R
+    # bytes at most) — none may be gathered-block-sized (~n x D x R)
+    for shape in ags:
+        m = re.match(r"f32\[(\d+),(\d+)\]", shape)
+        assert m, f"non-2D all-gather: {shape}"
+        assert int(m.group(1)) <= max(u_lay.slots, i_lay.slots)
+        assert int(m.group(2)) == rank
+
+
 def test_geometric_tiers_and_zero_drop():
     """Auto tiers: every entry kept (zero drop), padding bounded, and an
     explicit tuple auto-extends past its last edge instead of dropping."""
