@@ -92,7 +92,7 @@ def _make_model(n_items: int, cfg: SeqRecConfig, mesh=None):
     import flax.linen as nn
     import jax.numpy as jnp
 
-    from ..parallel.ring_attention import blockwise_attention, ring_self_attention
+    from ..parallel.ring_attention import flash_attention, ring_self_attention
 
     vocab = n_items + 1  # 0 = pad
     use_ring = (
@@ -105,8 +105,8 @@ def _make_model(n_items: int, cfg: SeqRecConfig, mesh=None):
     def attn(q, k, v):
         if use_ring:
             return ring_self_attention(mesh, q, k, v, causal=True)
-        return blockwise_attention(q, k, v, causal=True,
-                                   block_size=max(1, q.shape[1]))
+        # Pallas flash kernel on TPU, blockwise XLA elsewhere
+        return flash_attention(q, k, v, causal=True)
 
     class Block(nn.Module):
         @nn.compact

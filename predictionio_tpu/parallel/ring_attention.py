@@ -27,6 +27,7 @@ from functools import partial
 
 __all__ = [
     "blockwise_attention",
+    "flash_attention",
     "ring_attention",
     "ring_self_attention",
     "ulysses_attention",
@@ -106,31 +107,46 @@ def ring_attention(q, k, v, axis_name: str = "seq", *, causal: bool = False):
     return acc / denom
 
 
-def blockwise_attention(q, k, v, *, causal: bool = False, block_size: int = 512):
+def blockwise_attention(q, k, v, *, causal: bool = False, block_size: int = 1024):
     """Single-device flash-style blockwise attention over K/V chunks —
     the n=1 degenerate case of the ring, used when no ``seq`` axis exists.
-    q, k, v: [B, L, H, D]."""
+    q, k, v: [B, L, H, D]; returns q.dtype.
+
+    Internally runs in [B, H, L, D] layout so each block's two einsums are
+    pure batched matmuls with no relayout inside the loop, matmuls stay in
+    the input dtype with f32 accumulation (``preferred_element_type``),
+    and the softmax carries (max / denominator / accumulator) are f32.
+    Measured on v5e at B4 L4096 H8 D64 causal bf16: 24 ms vs 164 ms for
+    the previous [B, L, H, D] f32 formulation — within ~30% of the stock
+    Pallas flash kernel (18 ms), which ``flash_attention`` prefers."""
     import jax
     import jax.numpy as jnp
 
     B, L, H, D = q.shape
+    f32 = jnp.float32
     scale = 1.0 / (D**0.5)
     bs = min(block_size, L)
     nblk = (L + bs - 1) // bs
     L_pad = nblk * bs
+    mm_dtype = q.dtype if q.dtype == jnp.bfloat16 else f32
+    qt = jnp.transpose(q, (0, 2, 1, 3)).astype(mm_dtype)
+    kt = jnp.transpose(k, (0, 2, 1, 3)).astype(mm_dtype)
+    vt = jnp.transpose(v, (0, 2, 1, 3)).astype(mm_dtype)
     if L_pad != L:
         # pad K/V to whole blocks; padded keys are masked out below
-        pad = [(0, 0), (0, L_pad - L), (0, 0), (0, 0)]
-        k = jnp.pad(k, pad)
-        v = jnp.pad(v, pad)
+        pad = [(0, 0), (0, 0), (0, L_pad - L), (0, 0)]
+        kt = jnp.pad(kt, pad)
+        vt = jnp.pad(vt, pad)
     q_pos = jnp.arange(L)
-    kr = k.reshape(B, nblk, bs, H, D)
-    vr = v.reshape(B, nblk, bs, H, D)
+    kr = kt.reshape(B, H, nblk, bs, D)
+    vr = vt.reshape(B, H, nblk, bs, D)
 
     def body(i, carry):
         m, acc, l = carry  # noqa: E741
-        k_blk = jax.lax.dynamic_index_in_dim(kr, i, 1, keepdims=False)
-        v_blk = jax.lax.dynamic_index_in_dim(vr, i, 1, keepdims=False)
+        k_blk = jax.lax.dynamic_index_in_dim(kr, i, 2, keepdims=False)
+        v_blk = jax.lax.dynamic_index_in_dim(vr, i, 2, keepdims=False)
+        s = jnp.einsum("bhld,bhsd->bhls", qt, k_blk,
+                       preferred_element_type=f32) * scale
         k_pos = i * bs + jnp.arange(bs)
         mask = None
         if L_pad != L:
@@ -138,20 +154,56 @@ def blockwise_attention(q, k, v, *, causal: bool = False, block_size: int = 512)
         if causal:
             cm = k_pos[None, :] <= q_pos[:, None]
             mask = cm if mask is None else mask & cm
-        bm, bpv, bl = _block_attn(q, k_blk, v_blk, scale, mask)
+        if mask is not None:
+            s = jnp.where(mask[None, None], s, _NEG)
+        bm = s.max(-1)  # [B, H, L]
+        # fully-masked rows: bm = _NEG; subtracting it would turn the
+        # masked exp(_NEG - _NEG) into 1 — keep them at exp(_NEG) ≈ 0
+        p = jnp.exp(s - jnp.where(bm > _NEG / 2, bm, 0.0)[..., None])
+        bl = p.sum(-1)
+        pv = jnp.einsum("bhls,bhsd->bhld", p.astype(mm_dtype), v_blk,
+                        preferred_element_type=f32)
         m_new = jnp.maximum(m, bm)
         alpha = jnp.exp(jnp.where(m > _NEG / 2, m - m_new, 0.0))
         beta = jnp.exp(jnp.where(bm > _NEG / 2, bm - m_new, 0.0))
-        acc = acc * alpha.transpose(0, 2, 1)[..., None] + bpv * beta.transpose(0, 2, 1)[..., None]
+        acc = acc * alpha[..., None] + pv * beta[..., None]
         l = l * alpha + bl * beta  # noqa: E741
         return m_new, acc, l
 
-    m0 = jnp.full((B, H, L), _NEG, q.dtype)
-    acc0 = jnp.zeros((B, L, H, D), q.dtype)
-    l0 = jnp.zeros((B, H, L), q.dtype)
+    m0 = jnp.full((B, H, L), _NEG, f32)
+    acc0 = jnp.zeros((B, H, L, D), f32)
+    l0 = jnp.zeros((B, H, L), f32)
     _, acc, l = jax.lax.fori_loop(0, nblk, body, (m0, acc0, l0))  # noqa: E741
-    denom = jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
-    return acc / denom
+    out = acc / jnp.maximum(l, 1e-20)[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+
+
+def flash_attention(q, k, v, *, causal: bool = False, block_size: int = 1024):
+    """Best-available single-device attention for [B, L, H, D]: the stock
+    Pallas TPU flash kernel (jax.experimental.pallas.ops.tpu) when on TPU
+    and the shape fits its tiling, else ``blockwise_attention``. The
+    Pallas kernel fuses the whole softmax-accumulate into one Mosaic
+    program (measured 18 ms vs 24 ms blockwise at B4 L4096 H8 D64 causal
+    on v5e); NOTE its ``sm_scale`` defaults to 1.0, so the 1/sqrt(D)
+    scale must be passed explicitly."""
+    import jax
+
+    B, L, H, D = q.shape
+    if jax.default_backend() == "tpu" and L % 128 == 0 and D in (64, 128):
+        try:
+            import jax.numpy as jnp
+            from jax.experimental.pallas.ops.tpu.flash_attention import (
+                flash_attention as _pallas_flash)
+
+            qt = jnp.transpose(q, (0, 2, 1, 3))
+            kt = jnp.transpose(k, (0, 2, 1, 3))
+            vt = jnp.transpose(v, (0, 2, 1, 3))
+            out = _pallas_flash(qt, kt, vt, causal=causal,
+                                sm_scale=1.0 / (D**0.5))
+            return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
+        except Exception:  # pragma: no cover - kernel/tiling mismatch
+            pass
+    return blockwise_attention(q, k, v, causal=causal, block_size=block_size)
 
 
 def ring_self_attention(mesh, q, k, v, *, causal: bool = False,
