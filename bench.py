@@ -47,6 +47,49 @@ def synth_ml20m(n: int, seed: int = 0):
     return users, items, vals
 
 
+V5E_HBM_GBPS = 819  # v5e peak HBM bandwidth
+
+
+def expected_iter_traffic_gb(u_lay, i_lay, rank: int, cg_iters: int,
+                             bf16: bool) -> float:
+    """Expected HBM bytes of ONE ALS iteration (both half-steps), derived
+    from the layout's static shapes — the roofline tripwire VERDICT r3
+    item 4 asked for: a padding or traffic regression (tier drift, CG
+    depth change, gather blowup) now shifts hbm_util_pct visibly instead
+    of silently eating iters/sec.
+
+    Terms per side (PERF_NOTES "where the step time goes"):
+    - factor gather: each gathered row physically reads a full (8,128)
+      lane tile (measured row-rate is FLAT for 32-256 B rows — the tile,
+      not the row, is the traffic unit), plus the gathered block write;
+    - gramian einsums: re-read the gathered blocks + ratings, write the
+      per-OWNER normal equations [covered, R, R] (+ b / n / diag), where
+      covered = sum of tier spans — chunked tiers segment-sum their
+      per-chunk f32 partials down to span owner rows first (that extra
+      partial write+read is counted separately);
+    - CG: cg_iters + 1 matvecs re-read the owner equations each
+      iteration (the matvec's A copy is bf16 when the step is).
+    """
+    fb = 2 if bf16 else 4
+    tile = 8 * 128 * fb
+    eq_bytes = rank * rank * fb + rank * 4 + 4
+    total = 0.0
+    for lay in (u_lay, i_lay):
+        nnz_pad = sum(int(np.prod(b.ids.shape)) for b in lay.buckets)
+        covered = sum(int(m.span) for m in lay.metas)
+        chunk_rows = sum(
+            int(b.ids.shape[0]) * int(b.ids.shape[1])
+            for b, m in zip(lay.buckets, lay.metas) if m.seg is not None)
+        gather = nnz_pad * tile + nnz_pad * rank * fb
+        gramian = nnz_pad * (rank * fb + fb) + covered * eq_bytes
+        # chunked tiers: per-chunk partial equations are written and
+        # re-read in f32 by the per-owner segment sum
+        gramian += 2 * chunk_rows * rank * rank * 4
+        solve = (cg_iters + 1) * covered * rank * rank * fb
+        total += gather + gramian + solve
+    return total / 1e9
+
+
 def run_bench(n_ratings: int, iters: int, device_kind: str,
               compute_dtype: str = "float32") -> dict:
     import jax
@@ -116,6 +159,23 @@ def run_bench(n_ratings: int, iters: int, device_kind: str,
     assert np.isfinite(final).all()
     log(f"[{device_kind}] {iters} iters in {dt:.2f}s -> {iters/dt:.3f} iters/sec")
 
+    # roofline accounting (TPU only — the CPU floor/fallback runs have a
+    # different memory system; quoting a v5e roofline there would be
+    # noise a reader might compare against real chip runs)
+    hbm_gbps = hbm_util = traffic_gb = None
+    if jax.devices()[0].platform == "tpu":
+        from predictionio_tpu.models.als import DEFAULT_CG_ITERS_WARM
+
+        traffic_gb = expected_iter_traffic_gb(
+            u_lay, i_lay, RANK, DEFAULT_CG_ITERS_WARM,
+            bf16=compute_dtype == "bfloat16")
+        peak = V5E_HBM_GBPS * len(jax.devices())  # per-chip peak x chips
+        hbm_gbps = traffic_gb / (dt / iters)
+        hbm_util = 100.0 * hbm_gbps / peak
+        log(f"[{device_kind}] expected traffic {traffic_gb:.1f} GB/iter -> "
+            f"achieved {hbm_gbps:.0f} GB/s = {hbm_util:.0f}% of "
+            f"{len(jax.devices())}-chip v5e peak ({peak} GB/s)")
+
     # PIO_BENCH_PROFILE=<dir>: capture a jax.profiler trace of one extra
     # iteration for offline XProf/TensorBoard inspection (the workflow
     # tracing hook, workflow/tracing.py; non-fatal — some remote
@@ -131,8 +191,13 @@ def run_bench(n_ratings: int, iters: int, device_kind: str,
             log(f"[{device_kind}] profiler trace captured -> {prof_dir}")
         except Exception as e:  # noqa: BLE001
             log(f"[{device_kind}] profiler capture unavailable: {e}")
-    return {"iters_per_sec": iters / dt, "n_ratings": n_ratings,
-            "u": np.asarray(u)[u_lay.pos], "v": np.asarray(v)[i_lay.pos]}
+    out = {"iters_per_sec": iters / dt, "n_ratings": n_ratings,
+           "u": np.asarray(u)[u_lay.pos], "v": np.asarray(v)[i_lay.pos]}
+    if hbm_gbps is not None:
+        out.update(hbm_gbps=round(hbm_gbps),
+                   hbm_util_pct=round(hbm_util, 1),
+                   traffic_gb_per_iter=round(traffic_gb, 1))
+    return out
 
 
 def dispatch_floor_ms(n: int = 50) -> float:
@@ -809,6 +874,13 @@ def main() -> None:
     n_timed = N_RATINGS if platform == "tpu" else CPU_SUBSAMPLE
     result = run_bench(n_timed, TIMED_ITERS, "chip", compute_dtype=cdt)
     value = result["iters_per_sec"]
+    if platform == "tpu" and result.get("hbm_util_pct", 100) < 35:
+        # roofline floor: the step is HBM-bound by design (~70-90%
+        # expected); falling under 35% means a padding/traffic/launch
+        # regression, not noise
+        raise AssertionError(
+            f"hbm_util_pct {result['hbm_util_pct']} < 35 — the ALS step "
+            f"regressed off its memory-bound roofline")
     if platform != "tpu":
         # scale the subsample wall rate to the full-size equivalent so the
         # number is at least comparable to the cpu floor's convention
@@ -862,6 +934,9 @@ def main() -> None:
         "config": {"compute_dtype": cdt, "solver": "cg",
                    "platform": platform,
                    "accuracy_gap_rmse": round(gap, 6),
+                   **{k: result[k] for k in
+                      ("hbm_gbps", "hbm_util_pct", "traffic_gb_per_iter")
+                      if k in result},
                    "floor_config": "float32/cg", **extras},
     }))
 
