@@ -69,6 +69,55 @@ def _engine_from_variant(engine_dir: Path, variant: dict):
     return resolve_engine_factory(factory, engine_dir=engine_dir)
 
 
+def _verify_template_min_version(engine_dir: Path) -> None:
+    """Warn when the engine's ``template.json`` declares a minimum
+    framework version newer than this one (reference
+    Template.verifyTemplateMinVersion, console/Template.scala:417-425,
+    called by train/deploy, Console.scala:808,831). template.json shape:
+    ``{"pio": {"version": {"min": "X.Y.Z"}}}``. Missing or unparseable
+    metadata is not an error — in-repo templates rarely carry it, but
+    ``pio template get`` copies engines out where they can drift."""
+    path = engine_dir / "template.json"
+    if not path.exists():
+        return
+    try:
+        with open(path) as f:
+            meta = json.load(f)
+        min_v = meta["pio"]["version"]["min"]
+    except (json.JSONDecodeError, KeyError, TypeError, OSError,
+            UnicodeDecodeError):
+        print(f"[WARN] {path} cannot be parsed. Template metadata will "
+              f"not be available.", file=sys.stderr)
+        return
+
+    def parse_v(s):
+        """Leading numeric segments of a version string ("v2.1-rc" ->
+        [2, 1]); [] when nothing numeric leads."""
+        parts = []
+        for seg in str(s).strip().lstrip("vV").split("."):
+            digits = ""
+            for ch in seg:
+                if not ch.isdigit():
+                    break
+                digits += ch
+            if not digits:
+                break
+            parts.append(int(digits))
+        return parts
+
+    cur, need = parse_v(__version__), parse_v(min_v)
+    if not need:
+        print(f"[WARN] {path} declares an unparseable minimum version "
+              f"{min_v!r}; skipping the version check.", file=sys.stderr)
+        return
+    width = max(len(cur), len(need))
+    pad = lambda p: p + [0] * (width - len(p))  # noqa: E731
+    if pad(cur) < pad(need):
+        print(f"[WARN] This engine template requires at least "
+              f"predictionio_tpu {min_v}. The template may not work with "
+              f"predictionio_tpu {__version__}.", file=sys.stderr)
+
+
 def _engine_ids(engine_dir: Path, variant: dict) -> tuple[str, str, str]:
     engine_id = variant.get("id") or engine_dir.resolve().name
     version = str(variant.get("version", "1"))
@@ -254,6 +303,7 @@ def cmd_train(args) -> int:
 
     _enable_compile_cache()
     engine_dir = Path(args.engine_dir)
+    _verify_template_min_version(engine_dir)
     variant = _load_variant(engine_dir, args.engine_json)
     engine = _engine_from_variant(engine_dir, variant)
     engine_id, version, variant_id = _engine_ids(engine_dir, variant)
@@ -352,6 +402,7 @@ def cmd_deploy(args) -> int:
     from ..workflow.create_server import run_engine_server
 
     engine_dir = Path(args.engine_dir)
+    _verify_template_min_version(engine_dir)
     variant = _load_variant(engine_dir, args.engine_json)
     engine = _engine_from_variant(engine_dir, variant)
     engine_id, version, variant_id = _engine_ids(engine_dir, variant)

@@ -376,15 +376,75 @@ def create_engine_server_app(server: EngineServer) -> web.Application:
     return app
 
 
+def undeploy_stale(ip: str, port: int) -> None:
+    """Probe ``ip:port`` for a stale engine server and ask it to stop —
+    the MasterActor's pre-bind undeploy (reference CreateServer.scala:
+    266-288): GET /stop on a live engine server frees the port; a 404 or
+    unexpected status means some OTHER process owns the port (log and
+    let the bind retries surface the failure); connection refused means
+    the port is free."""
+    import urllib.error
+    import urllib.request
+
+    url = f"http://{ip}:{port}"
+    try:
+        with urllib.request.urlopen(f"{url}/stop", timeout=3) as resp:
+            if resp.status == 200:
+                log.info("Undeployed a stale engine server at %s", url)
+                time.sleep(0.5)  # let it release the port
+            else:
+                log.error("Another process is using %s (HTTP %d). "
+                          "Unable to undeploy.", url, resp.status)
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            log.error("Another process is using %s. Unable to undeploy.",
+                      url)
+        else:
+            log.error("An existing server at %s is not responding "
+                      "properly (HTTP %d). Unable to undeploy.", url, e.code)
+    except (ConnectionError, urllib.error.URLError, OSError, TimeoutError):
+        log.debug("Nothing at %s", url)
+
+
 def run_engine_server(
     engine: Engine,
     instance: EngineInstance,
     ip: str = "0.0.0.0",
     port: int = 8000,
+    bind_retries: int = 3,
     **kwargs,
 ) -> None:
-    """Blocking entry (reference default port 8000, ServerConfig :77-92)."""
+    """Blocking entry (reference default port 8000, ServerConfig :77-92).
+
+    Before binding, any stale engine server on the port is asked to
+    /stop, and a failed bind retries ``bind_retries`` times with 1 s
+    backoff before exiting with a diagnostic instead of a raw traceback
+    (reference MasterActor, CreateServer.scala:264-288 + :340-350)."""
+    import errno
+
     logging.basicConfig(level=logging.INFO)
+    # probe BEFORE the expensive model rehydration: a stale server gets
+    # the whole prepare_deploy duration to release the port, and a
+    # foreign occupant is reported without first loading a model
+    undeploy_stale("127.0.0.1" if ip in ("0.0.0.0", "::") else ip, port)
     server = EngineServer(engine, instance, **kwargs)
     log.info("Engine server (instance %s) starting on %s:%d", instance.id, ip, port)
-    web.run_app(create_engine_server_app(server), host=ip, port=port, print=None)
+    for attempt in range(bind_retries + 1):
+        try:
+            # a fresh app per attempt: a failed bind runs the previous
+            # app's cleanup hooks
+            web.run_app(create_engine_server_app(server), host=ip,
+                        port=port, print=None)
+            return
+        except OSError as e:
+            if e.errno != errno.EADDRINUSE:
+                raise
+            if attempt < bind_retries:
+                log.error("Bind to %s:%d failed (address in use). "
+                          "Retrying... (%d more trial(s))",
+                          ip, port, bind_retries - attempt)
+                time.sleep(1.0)
+    raise SystemExit(
+        f"Bind to {ip}:{port} failed after {bind_retries + 1} attempts: "
+        f"the address is in use and the occupant did not answer /stop. "
+        f"Choose another --port or stop the other process.")

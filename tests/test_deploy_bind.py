@@ -1,0 +1,113 @@
+"""Deploy robustness: pre-bind stale-instance undeploy + bind retry
+(reference MasterActor, CreateServer.scala:264-288 undeploy, :340-350
+bind retry). A port collision must yield the reference's behavior —
+stop a stale engine server, retry the bind, exit with a diagnostic —
+not a raw OSError traceback."""
+
+from __future__ import annotations
+
+import http.server
+import socket
+import threading
+
+import pytest
+import requests
+
+from predictionio_tpu.controller import EngineParams
+from predictionio_tpu.storage import Storage
+from predictionio_tpu.testing.sample_engine import (
+    SampleAlgoParams,
+    SampleDataSourceParams,
+    make_sample_engine,
+)
+from predictionio_tpu.workflow import Context, run_train
+from predictionio_tpu.workflow.create_server import (
+    EngineServer,
+    create_engine_server_app,
+    run_engine_server,
+    undeploy_stale,
+)
+from tests.helpers import ServerThread
+
+
+def _trained_sample():
+    engine = make_sample_engine()
+    ep = EngineParams(
+        data_source_params=("", SampleDataSourceParams(id=0)),
+        algorithm_params_list=(("sample", SampleAlgoParams(id=1)),),
+    )
+    iid = run_train(engine, ep, Context(),
+                    engine_factory="predictionio_tpu.testing."
+                                   "sample_engine:make_sample_engine")
+    return engine, Storage.get_metadata().engine_instance_get(iid)
+
+
+class _Stubborn(http.server.BaseHTTPRequestHandler):
+    """A non-engine occupant: answers /stop with 404 (the reference's
+    'another process is using this port' case)."""
+
+    def do_GET(self):  # noqa: N802 - stdlib naming
+        self.send_response(404)
+        self.end_headers()
+
+    def log_message(self, *a):  # quiet
+        pass
+
+
+def test_bind_collision_diagnostic_not_traceback(caplog):
+    """Deploying onto a port held by a foreign process retries, then
+    exits with a clear SystemExit diagnostic."""
+    httpd = http.server.HTTPServer(("127.0.0.1", 0), _Stubborn)
+    port = httpd.server_address[1]
+    t = threading.Thread(target=httpd.serve_forever, daemon=True)
+    t.start()
+    try:
+        engine, inst = _trained_sample()
+        with pytest.raises(SystemExit, match=r"address is in use"):
+            run_engine_server(engine, inst, ip="127.0.0.1", port=port,
+                              bind_retries=1)
+        assert any("Unable to undeploy" in r.message for r in caplog.records)
+        assert any("Retrying" in r.message for r in caplog.records)
+    finally:
+        httpd.shutdown()
+
+
+def test_undeploy_stale_asks_engine_server_to_stop(caplog):
+    """A stale ENGINE server on the port gets a /stop request (the happy
+    undeploy path)."""
+    import logging
+
+    engine, inst = _trained_sample()
+    st = ServerThread(
+        lambda: create_engine_server_app(EngineServer(engine, inst)))
+    try:
+        with caplog.at_level(logging.INFO, "predictionio_tpu.server"):
+            undeploy_stale("127.0.0.1", st.port)
+        assert any("Undeployed a stale engine server" in r.message
+                   for r in caplog.records)
+    finally:
+        st.stop()
+
+
+def test_undeploy_stale_free_port_is_silent():
+    """Nothing on the port: undeploy is a quiet no-op (no exception)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        free_port = s.getsockname()[1]
+    undeploy_stale("127.0.0.1", free_port)
+
+
+def test_second_deploy_replaces_stale_server():
+    """The reference's double-deploy flow: the second deploy's pre-bind
+    undeploy stops the first server (GET /stop answers 200 and the
+    server begins shutdown)."""
+    engine, inst = _trained_sample()
+    st = ServerThread(
+        lambda: create_engine_server_app(EngineServer(engine, inst)))
+    try:
+        assert requests.get(st.url + "/").status_code == 200
+        r = requests.get(st.url + "/stop", timeout=5)
+        assert r.status_code == 200
+        assert r.json()["message"] == "Shutting down."
+    finally:
+        st.stop()

@@ -55,8 +55,12 @@ def test_dashboard_lists_and_serves_results():
         assert r.status_code == 200
         r = requests.get(f"{st.url}/engine_instances/nope/evaluator_results.txt")
         assert r.status_code == 404
-        # CORS headers present
+        # CORS headers present on preflight AND regular responses
+        # (reference CorsSupport.scala adds allow-origin to every reply)
         r = requests.options(st.url + "/")
+        assert r.headers["Access-Control-Allow-Origin"] == "*"
+        assert "GET" in r.headers["Access-Control-Allow-Methods"]
+        r = requests.get(st.url + "/")
         assert r.headers["Access-Control-Allow-Origin"] == "*"
     finally:
         st.stop()

@@ -140,6 +140,42 @@ def test_template_list_and_get(tmp_path, capsys):
     assert (dest / "engine.json").exists()
 
 
+def test_template_min_version_gate(tmp_path, capsys):
+    """template.json's {"pio": {"version": {"min": ...}}} is checked by
+    train/deploy (reference Console.scala:808,831 + Template.scala:417):
+    a too-new requirement warns, a satisfied one stays quiet, and garbage
+    metadata warns without aborting."""
+    import json as _json
+
+    from predictionio_tpu.tools.cli import _verify_template_min_version
+
+    d = tmp_path / "eng"
+    d.mkdir()
+
+    # no template.json: silent
+    _verify_template_min_version(d)
+    assert capsys.readouterr().err == ""
+
+    # satisfied min: silent
+    (d / "template.json").write_text(
+        _json.dumps({"pio": {"version": {"min": "0.0.1"}}}))
+    _verify_template_min_version(d)
+    assert "requires at least" not in capsys.readouterr().err
+
+    # too-new min: warning naming both versions (warn, not abort —
+    # reference behavior)
+    (d / "template.json").write_text(
+        _json.dumps({"pio": {"version": {"min": "99.0.0"}}}))
+    _verify_template_min_version(d)
+    err = capsys.readouterr().err
+    assert "requires at least" in err and "99.0.0" in err
+
+    # unparseable metadata: warning, no exception
+    (d / "template.json").write_text("{nope")
+    _verify_template_min_version(d)
+    assert "cannot be parsed" in capsys.readouterr().err
+
+
 def test_eval_via_cli(engine_dir, tmp_path, rng, capsys):
     """pio eval with an Evaluation + EngineParamsGenerator defined in the
     engine dir (reference quickstart tuning flow)."""
