@@ -347,6 +347,76 @@ def catalog_1m_latency() -> dict:
             "catalog_1m_device_ms": round(dev_ms, 3)}
 
 
+def scale_bench() -> dict:
+    """One scale point beyond ML-20M per round (VERDICT r3 item 8):
+    100M synthetic ratings over 2M users x 1M items, rank 64 bf16 —
+    5x the ratings, ~15x the users, ~37x the catalog. Records the
+    full-pipeline costs that 'scales' actually depends on: layout build,
+    host->device transfer, iters/sec, and dropped entries (must be 0)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from predictionio_tpu.models.als import make_train_step, put_layout
+    from predictionio_tpu.ops.neighbors import build_bilinear_layout
+    from predictionio_tpu.parallel.mesh import make_mesh
+
+    nu, ni, n = 2_000_000, 1_000_000, 100_000_000
+    rng = np.random.default_rng(17)
+    t0 = time.time()
+    ranks = np.arange(1, ni + 1, dtype=np.float64)
+    pop = 1.0 / ranks**0.9
+    pop = np.minimum(pop / pop.sum(), 5 * 67_000 / n)  # scale the cap too
+    pop /= pop.sum()
+    items = rng.choice(ni, size=n, p=pop).astype(np.int32)
+    users = rng.integers(0, nu, n).astype(np.int32)
+    vals = (np.round(rng.random(n) * 9 + 1) / 2).astype(np.float32)
+    gen_s = time.time() - t0
+    log(f"[scale-100M] data gen: {gen_s:.1f}s")
+
+    t0 = time.time()
+    u_lay, i_lay = build_bilinear_layout(users, items, vals, nu, ni)
+    layout_s = time.time() - t0
+    dropped = u_lay.dropped + i_lay.dropped
+    log(f"[scale-100M] layout: {layout_s:.1f}s, dropped {dropped}")
+    del users, items, vals
+
+    mesh = make_mesh()
+    t0 = time.time()
+    u_bk = put_layout(u_lay, mesh, vals_dtype="bfloat16")
+    i_bk = put_layout(i_lay, mesh, vals_dtype="bfloat16")
+    rep = NamedSharding(mesh, P())
+    rngf = np.random.default_rng(1)
+    v = jax.device_put(
+        np.abs(rngf.normal(size=(i_lay.slots, RANK))).astype(np.float32)
+        / np.sqrt(RANK), rep)
+    u = jax.device_put(
+        np.abs(rngf.normal(size=(u_lay.slots, RANK))).astype(np.float32)
+        / np.sqrt(RANK), rep)
+    put_s = time.time() - t0
+    log(f"[scale-100M] device_put: {put_s:.1f}s")
+
+    step = make_train_step(mesh, u_lay, i_lay, rank=RANK, lambda_=0.1,
+                           compute_dtype="bfloat16")
+    t0 = time.time()
+    u, v = step(u_bk, i_bk, u, v)
+    np.asarray(u[:8])
+    compile_s = time.time() - t0
+    log(f"[scale-100M] compile+first iter: {compile_s:.1f}s")
+    iters = 3
+    t0 = time.time()
+    for _ in range(iters):
+        u, v = step(u_bk, i_bk, u, v)
+    final = np.asarray(u[:8])
+    dt = time.time() - t0
+    assert np.isfinite(final).all()
+    ips = iters / dt
+    log(f"[scale-100M] {iters} iters in {dt:.1f}s -> {ips:.3f} iters/sec")
+    return {"scale_100m_iters_per_sec": round(ips, 3),
+            "scale_100m_layout_s": round(layout_s, 1),
+            "scale_100m_device_put_s": round(put_s, 1),
+            "scale_100m_dropped": int(dropped)}
+
+
 def synth_clustered(n: int, n_users: int, n_clusters: int = 50,
                     seed: int = 11):
     """Cluster-structured interactions for the neural quality gates (the
@@ -902,6 +972,7 @@ def main() -> None:
             ("catalog-1M latency", catalog_1m_latency),
             ("two-tower", two_tower_bench),
             ("seqrec attention", seqrec_attention_bench),
+            ("scale-100M", scale_bench),
         ] + sections
     for name, fn in sections:
         try:

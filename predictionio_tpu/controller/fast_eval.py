@@ -43,6 +43,48 @@ class FastEvalEngine(Engine):
         self._prep_cache: dict[str, list] = {}
         self._algo_cache: dict[str, list] = {}
 
+    @classmethod
+    def wrap(cls, engine: Engine) -> "FastEvalEngine":
+        """Memoizing engine COMPOSED around an existing engine — the
+        analog of subclassing the reference's FastEvalEngine
+        (FastEvalEngine.scala:297-330, subclassable by design).
+
+        A plain ``Engine`` rebuilds directly. A custom subclass must opt
+        in with ``fast_eval_compatible = True`` — the wrapper is a
+        dynamic subclass of (FastEvalEngine, type(engine)), so the
+        custom class's component-resolution hooks (make_data_source and
+        friends) stay live while the memoized eval pipeline shadows its
+        eval/batch_eval; the marker is the subclass's assertion that
+        this shadowing does not change its results. Without the marker:
+        ValueError (silently dropping an eval override would change
+        evaluation results)."""
+        if isinstance(engine, FastEvalEngine):
+            return engine  # already memoizing — nothing to wrap
+        src = type(engine)
+        maps = dict(
+            data_source_classes=engine.data_source_classes,
+            preparator_classes=engine.preparator_classes,
+            algorithm_classes=engine.algorithm_classes,
+            serving_classes=engine.serving_classes,
+        )
+        if src is Engine:
+            return cls(**maps)
+        if not getattr(src, "fast_eval_compatible", False):
+            raise ValueError(
+                f"{src.__name__} overrides engine behavior; set "
+                f"'fast_eval_compatible = True' on the class to assert "
+                f"prefix memoization preserves its evaluation results, "
+                f"or wrap it in FastEvalEngine in code")
+        wrapped = type(f"FastEval{src.__name__}", (cls, src), {})
+        try:
+            return wrapped(**maps)
+        except TypeError as e:
+            # e.g. the subclass's __init__ takes a different signature —
+            # rebuilt-from-component-maps is the only contract wrap offers
+            raise ValueError(
+                f"cannot rebuild {src.__name__} from its component maps "
+                f"({e}); construct a FastEvalEngine in code instead") from e
+
     def train(self, ctx, engine_params: EngineParams):
         raise RuntimeError(
             "FastEvalEngine is for evaluation only; use Engine for deployment "

@@ -63,6 +63,14 @@ __all__ = ["ALSModel", "ALSConfig", "train_als"]
 #: the bench accuracy gate pin end-model quality). Implicit mode's
 #: normal equations (dense VᵀV + plain-λ ridge) are worse conditioned
 #: AND less diagonal — Jacobi helps less — so it runs deeper.
+#: equation-concat budget for _solve_side: below this, all tiers' normal
+#: equations concatenate into ONE batched solve (fewest launches); above
+#: it, tiers solve one at a time so peak HBM is bounded by the largest
+#: tier instead of [all rows, R, R] (at 2M users x rank 64 the concat is
+#: a 16+ GB buffer — more than a v5e's whole HBM). Same math either way:
+#: the batched CG is row-independent.
+SOLVE_EQ_BUDGET_BYTES = 1024**3
+
 DEFAULT_CG_ITERS = 8
 #: warm-started explicit solves (the training sweep seeds each inner
 #: solve with the row's previous factors, leaving CG only the sweep's
@@ -570,9 +578,11 @@ def _solve_side(buckets, layout, other, *, kw, x0=None):
            and kw.get("solver") == "cg" else jnp.float32)
     other_c = other.astype(cdt)
     f32 = jnp.float32
-    pas, pbs, pns, pds = [], [], [], []
-    covered = 0
-    for b, m in zip(buckets, layout.metas):
+
+    def tier_equations(b, m):
+        """One tier's regularization-free normal equations
+        (pa [span, R, R] cdt, pb [span, R] f32, pn [span] f32,
+        pd [span, R] f32)."""
         chunked = m.seg is not None
         if chunked:
             # partial gramians stay f32 through the per-owner sums so the
@@ -601,18 +611,60 @@ def _solve_side(buckets, layout, other, *, kw, x0=None):
             pb = pb.reshape(-1, rank)
             pn = pn.reshape(-1)
             pd = pd.reshape(-1, rank)
-        pas.append(pa)
-        pbs.append(pb)
-        pns.append(pn)
-        pds.append(pd)
-        covered += m.span
+        return pa, pb, pn, pd
+
+    def tier_solve(pa, pb, pn, pd, x0_t):
+        shift, gram = _ridge(other_c, pn, lambda_=kw["lambda_"],
+                             implicit=implicit)
+        return _spd_solve(pa, pb, solver=kw["solver"],
+                          cg_iters=kw["cg_iters"], matvec_dtype=cdt,
+                          shift=shift, gram=gram, diag=pd, x0=x0_t)
+
+    covered = sum(m.span for m in layout.metas)
+    eq_bytes = covered * rank * rank * jnp.dtype(cdt).itemsize
     cat = lambda xs: jnp.concatenate(xs) if len(xs) > 1 else xs[0]  # noqa: E731
-    a, bvec, n, d = cat(pas), cat(pbs), cat(pns), cat(pds)
-    shift, gram = _ridge(other_c, n, lambda_=kw["lambda_"],
-                         implicit=implicit)
-    x = _spd_solve(a, bvec, solver=kw["solver"], cg_iters=kw["cg_iters"],
-                   matvec_dtype=cdt, shift=shift, gram=gram, diag=d,
-                   x0=None if x0 is None else x0[:covered])
+    if eq_bytes <= SOLVE_EQ_BUDGET_BYTES:
+        # one global batched solve over the concatenated equations (fewer
+        # launches; the default path at ML-20M scale)
+        eqs = [tier_equations(b, m) for b, m in zip(buckets, layout.metas)]
+        a, bvec, n, d = (cat([e[i] for e in eqs]) for i in range(4))
+        x = tier_solve(a, bvec, n, d,
+                       None if x0 is None else x0[:covered])
+    else:
+        # PIECE-WISE solves: the [covered, R, R] equation concat would
+        # exceed the budget (at 2M rows x rank 64 it is a 16+ GB buffer —
+        # past a v5e's whole HBM). Regular tiers additionally split into
+        # block groups of at most ``rows_budget`` rows (a single tier can
+        # hold ~800k rows at 100M-rating scale — itself over budget once
+        # CG's relayouted matvec copy of the equations is counted); each
+        # piece's equations free right after its solve, bounding peak
+        # memory by the budget. CG here is row-independent (per-row
+        # alpha/beta, _spd_solve), so the split is mathematically
+        # identical to the global batch. Chunked tiers stay whole — their
+        # owner span is small by construction.
+        itemsize = jnp.dtype(cdt).itemsize
+        rows_budget = max(1, SOLVE_EQ_BUDGET_BYTES // (rank * rank * itemsize))
+        xs = []
+        off = 0
+        for b, m in zip(buckets, layout.metas):
+            if m.seg is not None:
+                pa, pb, pn, pd = tier_equations(b, m)
+                xs.append(tier_solve(
+                    pa, pb, pn, pd,
+                    None if x0 is None else x0[off:off + m.span]))
+                off += m.span
+                continue
+            nb, blk = b["ids"].shape[:2]
+            g = max(1, rows_budget // blk)  # blocks per solve group
+            for s in range(0, nb, g):
+                sub = {"ids": b["ids"][s:s + g], "vals": b["vals"][s:s + g]}
+                rows = int(sub["ids"].shape[0]) * blk
+                pa, pb, pn, pd = tier_equations(sub, m)
+                xs.append(tier_solve(
+                    pa, pb, pn, pd,
+                    None if x0 is None else x0[off:off + rows]))
+                off += rows
+        x = cat(xs)
     tail = layout.slots - covered
     if tail:
         x = jnp.concatenate([x, jnp.zeros((tail, rank), f32)])

@@ -353,24 +353,15 @@ def cmd_eval(args) -> int:
         # components, but pipeline prefixes (datasource folds, prepared
         # data, trained models) memoize across grid variants — the
         # reference requires subclassing FastEvalEngine in code
-        # (FastEvalEngine.scala:297); here it is one flag
-        from ..controller.engine import Engine
+        # (FastEvalEngine.scala:297); here it is one flag. Custom Engine
+        # subclasses opt in with `fast_eval_compatible = True` (their
+        # resolution hooks stay live; see FastEvalEngine.wrap).
         from ..controller.fast_eval import FastEvalEngine
 
-        e = evaluation.engine
-        if type(e) is not Engine:
-            # a custom Engine subclass may override eval()/batch_eval();
-            # rebuilding from the class maps alone would silently drop
-            # that behavior — refuse rather than change results
-            _die(f"--fast requires a plain Engine; {type(e).__name__} "
-                 "overrides engine behavior (wrap it in FastEvalEngine "
-                 "in code instead)")
-        evaluation.engine = FastEvalEngine(
-            data_source_classes=e.data_source_classes,
-            preparator_classes=e.preparator_classes,
-            algorithm_classes=e.algorithm_classes,
-            serving_classes=e.serving_classes,
-        )
+        try:
+            evaluation.engine = FastEvalEngine.wrap(evaluation.engine)
+        except ValueError as e:
+            _die(str(e))
     if args.engine_params_generator:
         gen_obj = resolve_attr(args.engine_params_generator,
                                engine_dir=engine_dir)

@@ -317,6 +317,24 @@ def test_model_sharded_odd_sizes(rng, mesh8):
         m_ms.item_factors, m_rep.item_factors, rtol=2e-4, atol=2e-5)
 
 
+def test_tier_wise_solve_matches_global(rng, mesh8, monkeypatch):
+    """Above SOLVE_EQ_BUDGET_BYTES, _solve_side solves tier-by-tier so
+    peak memory is bounded by the largest tier (the 100M-rating scale
+    path); the result must match the global concatenated solve — CG is
+    row-independent, so the split is exact math, not an approximation."""
+    import predictionio_tpu.models.als as als_mod
+
+    ratings, full, mask = make_ratings(rng, nu=80, ni=50)
+    cfg = ALSConfig(rank=8, iterations=4, lambda_=0.01, seed=9)
+    m_global = train_als(ratings, cfg, mesh=mesh8)
+    monkeypatch.setattr(als_mod, "SOLVE_EQ_BUDGET_BYTES", 1)  # force tiers
+    m_tiered = train_als(ratings, cfg, mesh=mesh8)
+    np.testing.assert_allclose(m_tiered.user_factors, m_global.user_factors,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(m_tiered.item_factors, m_global.item_factors,
+                               rtol=1e-5, atol=1e-6)
+
+
 def test_model_sharded_collective_inventory(mesh8):
     """The compiled model-sharded train step's communication story
     (VERDICT r3 item 2): the ONLY factor-sized collectives are one

@@ -114,3 +114,27 @@ def test_unknown_component_name():
     ep = sample_engine_params(algos=(("nope", SampleAlgoParams()),))
     with pytest.raises(KeyError, match="nope"):
         engine.train(ctx(), ep)
+
+
+def test_fast_eval_wrap_edge_cases():
+    """FastEvalEngine.wrap: idempotent on an already-memoizing engine,
+    and a ValueError (not a raw TypeError) when an opted-in subclass
+    cannot be rebuilt from its component maps (review r4 findings)."""
+    from predictionio_tpu.controller.engine import Engine
+    from predictionio_tpu.controller.fast_eval import FastEvalEngine
+
+    base = make_sample_engine()
+    fe = FastEvalEngine(base.data_source_classes, base.preparator_classes,
+                        base.algorithm_classes, base.serving_classes)
+    assert FastEvalEngine.wrap(fe) is fe
+
+    class Weird(Engine):
+        fast_eval_compatible = True
+
+        def __init__(self, config):  # non-standard signature
+            super().__init__(
+                config.data_source_classes, config.preparator_classes,
+                config.algorithm_classes, config.serving_classes)
+
+    with pytest.raises(ValueError, match="component maps"):
+        FastEvalEngine.wrap(Weird(base))

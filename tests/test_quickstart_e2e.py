@@ -264,3 +264,64 @@ class MyEval(Evaluation):
     # the longest shared prefix (datasource+preparator) hits once for the
     # second variant — shorter-prefix hits are subsumed by it
     assert "'preparator': 1" in out
+
+
+def test_eval_fast_custom_engine_opt_in(engine_dir, tmp_path, rng, capsys):
+    """A custom Engine subclass gets prefix memoization under
+    `pio eval --fast` by declaring fast_eval_compatible = True (the
+    reference's FastEvalEngine is subclassable by design,
+    FastEvalEngine.scala:297-330); without the marker --fast refuses."""
+    assert pio(["app", "new", "qtest"]) == 0
+    app = Storage.get_metadata().app_get_by_name("qtest")
+    events_file = tmp_path / "events.jsonl"
+    make_events_file(events_file, rng, nu=20, ni=12)
+    assert pio(["import", "--appid", str(app.id), "--input", str(events_file)]) == 0
+    (engine_dir / "evaluation.py").write_text('''
+from predictionio_tpu.controller import (AverageMetric, EngineParams,
+                                         Evaluation)
+from predictionio_tpu.controller.engine import Engine
+from engine import DataSourceParams, AlgorithmParams, engine_factory
+
+class Hit(AverageMetric):
+    def calculate_qpa(self, q, p, a):
+        return 1.0 if any(s.item == a["item"] for s in p.itemScores) else 0.0
+
+class MyEngine(Engine):
+    fast_eval_compatible = True  # opt-in: memoization keeps my results
+
+class NoOptIn(Engine):
+    pass
+
+def custom(cls):
+    base = engine_factory()
+    return cls(base.data_source_classes, base.preparator_classes,
+               base.algorithm_classes, base.serving_classes)
+
+GRID = [
+    EngineParams(
+        data_source_params=("", DataSourceParams(app_name="qtest", eval_k=2)),
+        algorithm_params_list=(("als", AlgorithmParams(rank=r, num_iterations=4)),),
+    )
+    for r in (2, 4)
+]
+
+class MyEval(Evaluation):
+    engine = custom(MyEngine)
+    metric = Hit()
+    engine_params_list = GRID
+
+class RefusedEval(Evaluation):
+    engine = custom(NoOptIn)
+    metric = Hit()
+    engine_params_list = GRID
+''')
+    assert pio(["eval", "--fast", "--engine-dir", str(engine_dir),
+                "evaluation:MyEval"]) == 0
+    out = capsys.readouterr().out
+    assert "FastEval prefix cache hits" in out
+    assert "'preparator': 1" in out
+
+    with pytest.raises(SystemExit):
+        pio(["eval", "--fast", "--engine-dir", str(engine_dir),
+             "evaluation:RefusedEval"])
+    assert "fast_eval_compatible" in capsys.readouterr().err

@@ -2,6 +2,7 @@
 predictions verified including the serving-time business filters (the
 reference's judge-checked workloads, SURVEY §2.8)."""
 
+import dataclasses
 import importlib.util
 import sys
 from pathlib import Path
@@ -162,6 +163,10 @@ class TestECommerce:
         )
         result = engine.train(Context(), ep)
         algo, model = result.algorithms[0], result.models[0]
+        # immediate constraint visibility for this test (the TTL cache's
+        # staleness bound is pinned separately below)
+        algo.params = dataclasses.replace(algo.params,
+                                          constraint_ttl_seconds=0.0)
 
         # unseen-only: u0's seen items (views+buys) are excluded
         out = algo.predict(model, mod.Query(user="u0", num=10))
@@ -183,6 +188,64 @@ class TestECommerce:
         # totally unknown user -> empty
         out = algo.predict(model, mod.Query(user="ghost", num=3))
         assert out.itemScores == ()
+
+    def test_constraint_ttl_and_batch_dedupe(self, rng, mesh8, monkeypatch):
+        """Serving-plane store traffic (VERDICT r3 weak #6): the global
+        unavailable-items read is TTL-cached (staleness bounded by
+        constraint_ttl_seconds) and a micro-batch dedupes seen-items
+        lookups per user."""
+        mod = load_template("ecommercerecommendation")
+        app = setup_app()
+        self._ingest(rng, app)
+        engine = mod.engine_factory()
+        ep = EngineParams(
+            data_source_params=("", mod.DataSourceParams(app_name="MyApp")),
+            algorithm_params_list=(
+                ("ecomm", mod.AlgorithmParams(
+                    app_name="MyApp", rank=4, num_iterations=4,
+                    unseen_only=True, constraint_ttl_seconds=30.0)),
+            ),
+        )
+        result = engine.train(Context(), ep)
+        algo, model = result.algorithms[0], result.models[0]
+
+        reads = {"constraint": 0, "seen": 0}
+        real_read = algo._read_unavailable_items
+        real_seen = algo._seen_items
+
+        def counting_read():
+            reads["constraint"] += 1
+            return real_read()
+
+        def counting_seen(user):
+            reads["seen"] += 1
+            return real_seen(user)
+
+        monkeypatch.setattr(algo, "_read_unavailable_items", counting_read)
+        monkeypatch.setattr(algo, "_seen_items", counting_seen)
+
+        # one micro-batch: 6 queries over 2 users -> 1 constraint read,
+        # 2 seen-items reads
+        queries = [(i, mod.Query(user=f"u{i % 2}", num=3))
+                   for i in range(6)]
+        out = dict(algo.batch_predict(model, queries))
+        assert len(out) == 6 and all(out[i].itemScores for i in range(6))
+        assert reads["constraint"] == 1
+        assert reads["seen"] == 2
+
+        # within the TTL, the next batch re-reads nothing global; a $set
+        # lands only after the TTL expires (staleness bound)
+        insert(app.id, event="$set", entity_type="constraint",
+               entity_id="unavailableItems", props={"items": ["i2"]})
+        out = dict(algo.batch_predict(
+            model, [(0, mod.Query(user="u0", num=10))]))
+        assert reads["constraint"] == 1  # cache hit — possibly stale
+        # force expiry instead of sleeping
+        algo._constraint_cache = (0.0, algo._constraint_cache[1])
+        out = dict(algo.batch_predict(
+            model, [(0, mod.Query(user="u0", num=10))]))
+        assert reads["constraint"] == 2
+        assert "i2" not in {s.item for s in out[0].itemScores}
 
 
 class TestSeqRec:
