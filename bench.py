@@ -32,6 +32,35 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+#: live bench child processes (e2e quickstart, cpu floor, sharding,
+#: ingest). The Watchdog kills these before its hard exit — an orphaned
+#: child hung in a wedged XLA call would otherwise hold the tunneled
+#: device and the deploy port into the driver's next run.
+_CHILDREN: list = []
+
+
+def run_child(cmd, **kwargs) -> "subprocess.CompletedProcess":
+    """subprocess.run with the child registered for watchdog cleanup and
+    its own session (so a kill reaches the whole process group)."""
+    timeout = kwargs.pop("timeout", None)
+    with subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                          stderr=subprocess.PIPE, text=True,
+                          start_new_session=True, **kwargs) as p:
+        _CHILDREN.append(p)
+        try:
+            stdout, stderr = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.communicate()
+            raise
+        finally:
+            try:
+                _CHILDREN.remove(p)
+            except ValueError:
+                pass
+    return subprocess.CompletedProcess(cmd, p.returncode, stdout, stderr)
+
+
 def synth_ml20m(n: int, seed: int = 0):
     """ML-20M-shaped synthetic ratings: zipf item popularity truncated at
     ML-20M's real max item degree (~67k ratings for the top movie), uniform
@@ -410,10 +439,17 @@ def scale_bench() -> dict:
     dt = time.time() - t0
     assert np.isfinite(final).all()
     ips = iters / dt
-    log(f"[scale-100M] {iters} iters in {dt:.1f}s -> {ips:.3f} iters/sec")
+    from predictionio_tpu.models.als import DEFAULT_CG_ITERS_WARM
+
+    traffic_gb = expected_iter_traffic_gb(u_lay, i_lay, RANK,
+                                          DEFAULT_CG_ITERS_WARM, bf16=True)
+    util = 100.0 * traffic_gb / (dt / iters) / V5E_HBM_GBPS
+    log(f"[scale-100M] {iters} iters in {dt:.1f}s -> {ips:.3f} iters/sec "
+        f"({traffic_gb:.0f} GB/iter, {util:.0f}% of peak)")
     return {"scale_100m_iters_per_sec": round(ips, 3),
             "scale_100m_layout_s": round(layout_s, 1),
             "scale_100m_device_put_s": round(put_s, 1),
+            "scale_100m_hbm_util_pct": round(util, 1),
             "scale_100m_dropped": int(dropped)}
 
 
@@ -656,8 +692,7 @@ print("E2E", time.time() - t_all)
 """
     env = dict(os.environ, REPO=os.path.dirname(os.path.abspath(__file__)),
                PIO_XLA_CACHE_DIR=cache_dir)
-    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                         text=True, env=env, timeout=1800)
+    out = run_child([sys.executable, "-c", code], env=env, timeout=1800)
     for line in out.stdout.splitlines():
         if line.startswith("E2E "):
             s = float(line.split()[1])
@@ -717,8 +752,7 @@ for shape, model_sharded in (((8, 1), False), ((4, 2), True)):
 """
     env = dict(os.environ, REPO=os.path.dirname(os.path.abspath(__file__)),
                JAX_PLATFORMS="cpu")
-    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                         text=True, env=env, timeout=1800)
+    out = run_child([sys.executable, "-c", code], env=env, timeout=1800)
     res = {}
     for line in out.stdout.splitlines():
         if line.startswith("MESH "):
@@ -781,8 +815,7 @@ finally:
 """
     env = dict(os.environ, REPO=os.path.dirname(os.path.abspath(__file__)),
                JAX_PLATFORMS="cpu")
-    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
-                         text=True, env=env, timeout=600)
+    out = run_child([sys.executable, "-c", code], env=env, timeout=600)
     for line in out.stdout.splitlines():
         if line.startswith("INGEST "):
             rate = float(line.split()[1])
@@ -819,10 +852,8 @@ def device_healthy(timeout_s: int = 180) -> bool:
             "print('HEALTHY', jax.default_backend(), "
             "jax.devices()[0].platform)\n")
     try:
-        out = subprocess.run([sys.executable, "-c", code],
-                             capture_output=True, text=True,
-                             timeout=timeout_s,
-                             cwd=os.path.dirname(os.path.abspath(__file__)))
+        out = run_child([sys.executable, "-c", code], timeout=timeout_s,
+                        cwd=os.path.dirname(os.path.abspath(__file__)))
     except subprocess.TimeoutExpired:
         return False
     if out.returncode != 0:
@@ -858,9 +889,8 @@ def cpu_floor() -> float:
     env["XLA_FLAGS"] = re.sub(
         r"--xla_force_host_platform_device_count=\d+", "",
         env.get("XLA_FLAGS", "")).strip()
-    out = subprocess.run(
-        [sys.executable, "-c", code],
-        capture_output=True, text=True, env=env, timeout=1800,
+    out = run_child(
+        [sys.executable, "-c", code], env=env, timeout=1800,
         cwd=os.path.dirname(os.path.abspath(__file__)),
     )
     log(out.stderr[-2000:])
@@ -909,11 +939,103 @@ def accuracy_gate(compute_dtype: str = "bfloat16") -> float:
     return gap
 
 
+class Watchdog:
+    """Mid-run wedge escape hatch. The start-of-run ``device_healthy``
+    probe cannot help when the tunneled platform wedges AFTER it passes
+    (observed round 4: the ML-20M section completed at 07:39, the
+    platform wedged at 07:40, and the bench hung in the next section's
+    backend call forever — a wedged XLA call holds the GIL-released C
+    frame and cannot be interrupted from Python). Each phase arms a
+    deadline; on expiry the watchdog emits the PARTIAL artifact JSON
+    (everything measured so far, labeled with the wedged phase) and
+    hard-exits, so the driver records data instead of a timeout."""
+
+    def __init__(self, emit):
+        import threading
+
+        self._emit = emit
+        self._lock = threading.Lock()
+        self._name = None
+        self._deadline = None
+        t = threading.Thread(target=self._run, daemon=True)
+        t.start()
+
+    def phase(self, name: str, seconds: float):
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            with self._lock:
+                self._name = name
+                self._deadline = time.monotonic() + seconds
+            try:
+                yield
+            finally:
+                with self._lock:
+                    self._name = self._deadline = None
+
+        return cm()
+
+    def _run(self):
+        while True:
+            time.sleep(5)
+            with self._lock:
+                name, deadline = self._name, self._deadline
+            if deadline is not None and time.monotonic() > deadline:
+                log(f"WATCHDOG: phase {name!r} exceeded its deadline — "
+                    f"platform likely wedged mid-run; emitting the "
+                    f"partial artifact and exiting")
+                for p in list(_CHILDREN):
+                    # orphaned children would keep holding the tunneled
+                    # device / deploy port into the driver's next run
+                    try:
+                        os.killpg(p.pid, 9)
+                    except (ProcessLookupError, PermissionError, OSError):
+                        pass
+                try:
+                    self._emit(wedged_in=name)
+                finally:
+                    sys.stdout.flush()
+                    os._exit(2)
+
+
 def main() -> None:
     # bf16 on the chip (half the gather traffic, MXU-rate einsums, f32
     # accumulation + f32 solve); the CPU floor stays f32 — each substrate
     # runs its natural best configuration. The accuracy gate above ties
     # the fast config's model quality to the exact solver's.
+    import threading
+
+    state = {"value": 0.0, "vs": 0.0, "cdt": "", "platform": "",
+             "gap": 0.0, "result": {}, "extras": {}}
+    # one lock serializes main-thread state updates against the
+    # watchdog's emit — without it a deadline firing mid-update could
+    # crash emit() on a mutating dict and lose the partial artifact
+    state_lock = threading.Lock()
+
+    def emit(wedged_in: str | None = None) -> None:
+        with state_lock:
+            result, extras = dict(state["result"]), dict(state["extras"])
+            value, vs = state["value"], state["vs"]
+            cdt, platform, gap = state["cdt"], state["platform"], state["gap"]
+        if wedged_in:
+            extras["partial"] = (f"platform wedged during {wedged_in!r}; "
+                                 f"artifact holds the phases that finished")
+        print(json.dumps({
+            "metric": "als_train_iters_per_sec_ml20m_rank64",
+            "value": round(value, 3),
+            "unit": "iters/sec/chip",
+            "vs_baseline": round(vs, 2),
+            "config": {"compute_dtype": cdt, "solver": "cg",
+                       "platform": platform,
+                       "accuracy_gap_rmse": round(gap, 6),
+                       **{k: result[k] for k in
+                          ("hbm_gbps", "hbm_util_pct", "traffic_gb_per_iter")
+                          if k in result},
+                       "floor_config": "float32/cg", **extras},
+        }))
+
+    wd = Watchdog(emit)
     platform = "tpu"
     for attempt in range(4):
         if device_healthy():
@@ -940,9 +1062,14 @@ def main() -> None:
     # there); each substrate runs its natural best configuration, and the
     # gate validates the SAME config the timed run uses
     cdt = "bfloat16" if platform == "tpu" else "float32"
-    gap = accuracy_gate(compute_dtype=cdt)
+    state["platform"], state["cdt"] = platform, cdt
+    with wd.phase("accuracy gate", 1200):
+        gap = accuracy_gate(compute_dtype=cdt)
+    state["gap"] = gap
     n_timed = N_RATINGS if platform == "tpu" else CPU_SUBSAMPLE
-    result = run_bench(n_timed, TIMED_ITERS, "chip", compute_dtype=cdt)
+    with wd.phase("timed ALS run", 2400):
+        result = run_bench(n_timed, TIMED_ITERS, "chip", compute_dtype=cdt)
+    state["result"] = result
     value = result["iters_per_sec"]
     if platform == "tpu" and result.get("hbm_util_pct", 100) < 35:
         # roofline floor: the step is HBM-bound by design (~70-90%
@@ -955,10 +1082,11 @@ def main() -> None:
         # scale the subsample wall rate to the full-size equivalent so the
         # number is at least comparable to the cpu floor's convention
         value *= n_timed / N_RATINGS
-    extras: dict = {}
+    state["value"] = value
+    extras = state["extras"]
     sections: list = [
-        ("factor sharding", factor_sharding_bench),
-        ("event ingest", event_ingest_throughput),
+        ("factor sharding", factor_sharding_bench, 2400),
+        ("event ingest", event_ingest_throughput, 900),
     ]
     if platform == "tpu":
         # serving latency and the e2e child need the real accelerator
@@ -966,50 +1094,44 @@ def main() -> None:
         # the quickstart subprocess would hang on a wedged platform)
         sections = [
             ("predict latency",
-             lambda: predict_latency(result["u"], result["v"])),
+             lambda: predict_latency(result["u"], result["v"]), 900),
             ("pipelined qps",
-             lambda: pipelined_qps(result["u"], result["v"])),
-            ("catalog-1M latency", catalog_1m_latency),
-            ("two-tower", two_tower_bench),
-            ("seqrec attention", seqrec_attention_bench),
-            ("scale-100M", scale_bench),
+             lambda: pipelined_qps(result["u"], result["v"]), 900),
+            ("catalog-1M latency", catalog_1m_latency, 900),
+            ("two-tower", two_tower_bench, 1200),
+            ("seqrec attention", seqrec_attention_bench, 900),
+            ("scale-100M", scale_bench, 1800),
         ] + sections
-    for name, fn in sections:
+    for name, fn, deadline_s in sections:
         try:
-            extras.update(fn())
+            with wd.phase(name, deadline_s):
+                res = fn()
+            with state_lock:
+                extras.update(res)
         except Exception as e:  # noqa: BLE001 — secondary, not load-bearing
             log(f"{name} unavailable: {e}")
     if platform == "tpu":
         try:
             import tempfile
 
-            with tempfile.TemporaryDirectory(prefix="pio_e2e_cache_") as cd:
-                extras["e2e_train_deploy_cold_s"] = round(
-                    e2e_quickstart("cold", cd), 1)
-                extras["e2e_train_deploy_s"] = round(
-                    e2e_quickstart("warm cache", cd), 1)
+            with tempfile.TemporaryDirectory(prefix="pio_e2e_cache_") as cd, \
+                    wd.phase("e2e quickstart", 1800):
+                cold = round(e2e_quickstart("cold", cd), 1)
+                warm = round(e2e_quickstart("warm cache", cd), 1)
+            with state_lock:
+                extras["e2e_train_deploy_cold_s"] = cold
+                extras["e2e_train_deploy_s"] = warm
         except Exception as e:  # noqa: BLE001
             log(f"e2e quickstart unavailable: {e}")
     try:
-        floor = cpu_floor()
+        with wd.phase("cpu floor", 2400):
+            floor = cpu_floor()
         log(f"cpu floor (scaled to 20M): {floor:.4f} iters/sec")
-        vs = value / floor
+        with state_lock:
+            state["vs"] = value / floor
     except Exception as e:  # noqa: BLE001 — floor is informative, not load-bearing
         log(f"cpu floor unavailable: {e}")
-        vs = 0.0
-    print(json.dumps({
-        "metric": "als_train_iters_per_sec_ml20m_rank64",
-        "value": round(value, 3),
-        "unit": "iters/sec/chip",
-        "vs_baseline": round(vs, 2),
-        "config": {"compute_dtype": cdt, "solver": "cg",
-                   "platform": platform,
-                   "accuracy_gap_rmse": round(gap, 6),
-                   **{k: result[k] for k in
-                      ("hbm_gbps", "hbm_util_pct", "traffic_gb_per_iter")
-                      if k in result},
-                   "floor_config": "float32/cg", **extras},
-    }))
+    emit()
 
 
 if __name__ == "__main__":

@@ -555,18 +555,24 @@ def _solve_side(buckets, layout, other, *, kw, x0=None):
     sums its pieces per owner; regularization and the compute-dtype cast
     fuse into each tier's einsum epilogue (the solver never touches an
     f32 gramian — at bf16 that halves CG's dominant re-read traffic);
-    then the tiers' equations CONCATENATE and ONE batched PCG solves the
-    whole side, emitting factors already in permuted order — the step
-    contains no scatter at all (a TPU scatter runs at ~3-12M rows/s; the
-    concats are contiguous writes). Degree-0 rows and padding slots are
-    the all-zero tail the layout reserves.
+    then the tiers' equations CONCATENATE and one batched PCG solves the
+    whole side (piece-wise past the equation budget — see below),
+    emitting factors already in permuted order — the step contains no
+    scatter at all (a TPU scatter runs at ~3-12M rows/s; the concats are
+    contiguous writes). Degree-0 rows and padding slots are the all-zero
+    tail the layout reserves.
 
     ``buckets`` are the device dicts from ``put_layout``; ``layout`` the
     host ``SideLayout`` (static spans/segments metadata). ``x0`` is this
     side's PREVIOUS permuted factor array ([slots, R]) used to warm-start
     the CG solve — its first ``covered`` rows line up with the
     concatenated equations by construction (factors live in
-    tier-concatenation order)."""
+    tier-concatenation order).
+
+    Above ``SOLVE_EQ_BUDGET_BYTES`` of equations, the single global
+    batched solve gives way to piece-wise solves (per tier, and within
+    large tiers per block group) so peak HBM is bounded by the budget —
+    the 100M-rating scale path; same math either way (CG is per-row)."""
     import jax
     import jax.numpy as jnp
 

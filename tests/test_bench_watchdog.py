@@ -1,0 +1,73 @@
+"""bench.py's mid-run wedge escape hatch: a phase exceeding its deadline
+must emit the partial artifact JSON and hard-exit — observed round 4, the
+tunneled platform wedged BETWEEN bench sections and the process hung
+forever with no artifact (a wedged XLA call cannot be interrupted from
+Python, so os._exit after emitting is the only escape)."""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def test_watchdog_emits_partial_and_exits():
+    code = r"""
+import json, sys, time
+sys.path.insert(0, %r)
+import bench
+
+def emit(wedged_in=None):
+    print(json.dumps({"partial": wedged_in, "value": 1.23}))
+
+wd = bench.Watchdog(emit)
+with wd.phase("fake wedge", 0.1):
+    time.sleep(60)  # the "wedged XLA call"
+print("UNREACHABLE")
+""" % str(REPO)
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=60)
+    assert out.returncode == 2
+    assert "UNREACHABLE" not in out.stdout
+    payload = json.loads(out.stdout.strip().splitlines()[-1])
+    assert payload == {"partial": "fake wedge", "value": 1.23}
+    assert "exceeded its deadline" in out.stderr
+
+
+def test_watchdog_idle_phases_do_not_fire():
+    code = r"""
+import sys, time
+sys.path.insert(0, %r)
+import bench
+
+wd = bench.Watchdog(lambda **k: print("EMITTED"))
+with wd.phase("quick", 30):
+    pass  # finishes well inside the deadline
+time.sleep(0.2)  # watchdog poll happens with no armed deadline
+print("DONE")
+""" % str(REPO)
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=60)
+    assert out.returncode == 0
+    assert "DONE" in out.stdout and "EMITTED" not in out.stdout
+
+
+def test_run_child_kills_on_timeout():
+    """run_child enforces its timeout and does not leave the child
+    registered (the watchdog kill list must not accumulate)."""
+    code = r"""
+import subprocess, sys
+sys.path.insert(0, %r)
+import bench
+
+try:
+    bench.run_child([sys.executable, "-c", "import time; time.sleep(60)"],
+                    timeout=0.5)
+    print("NO-RAISE")
+except subprocess.TimeoutExpired:
+    print("TIMED-OUT", len(bench._CHILDREN))
+""" % str(REPO)
+    out = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                         text=True, timeout=60)
+    assert "TIMED-OUT 0" in out.stdout
