@@ -19,6 +19,7 @@ import numpy as np
 
 __all__ = [
     "available",
+    "counting_argsort",
     "neighbor_blocks_native",
     "hash64_batch",
     "scan_jsonl",
@@ -64,7 +65,7 @@ def _build() -> bool:
     tmp = _LIB_PATH.with_suffix(f".so.tmp.{os.getpid()}")
     cmd = [
         os.environ.get("CXX", "g++"), "-O3", "-std=c++17", "-fPIC", "-shared",
-        str(_SRC), "-o", str(tmp),
+        "-pthread", str(_SRC), "-o", str(tmp),
     ]
     try:
         _BUILD_DIR.mkdir(exist_ok=True)
@@ -132,6 +133,10 @@ def _load() -> ctypes.CDLL | None:
         lib.pio_scan_jsonl.argtypes = [
             ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, i64p, i64p,
         ]
+        lib.pio_counting_argsort_i32.restype = ctypes.c_int32
+        lib.pio_counting_argsort_i32.argtypes = [
+            i32p, ctypes.c_int64, ctypes.c_int64, i64p,
+        ]
         _lib = lib
         return _lib
 
@@ -173,6 +178,21 @@ def neighbor_blocks_native(
     if dropped < 0:
         raise ValueError("pio_neighbor_blocks: invalid input")
     return ids, vv, mask, int(dropped)
+
+
+def counting_argsort(keys: np.ndarray, key_max: int) -> np.ndarray | None:
+    """Stable argsort of non-negative bounded int keys — bit-identical to
+    ``np.argsort(keys, kind="stable")`` (pinned by tests/test_native.py),
+    parallel counting sort in C++. None if the native lib is unavailable
+    or a key falls outside [0, key_max] (callers fall back to numpy)."""
+    lib = _load()
+    if lib is None:
+        return None
+    keys = np.ascontiguousarray(keys, np.int32)
+    out = np.empty(len(keys), np.int64)
+    if lib.pio_counting_argsort_i32(keys, len(keys), int(key_max), out) != 0:
+        return None
+    return out
 
 
 def hash64_batch(strings: list[bytes] | list[str], seed: int = 0) -> np.ndarray | None:

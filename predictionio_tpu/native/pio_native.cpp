@@ -21,6 +21,8 @@
 #include <cstdint>
 #include <cstring>
 #include <algorithm>
+#include <atomic>
+#include <thread>
 #include <utility>
 #include <vector>
 
@@ -137,6 +139,86 @@ int64_t pio_neighbor_blocks(const int64_t* rows, const int32_t* cols,
     dropped += cnt - d;
   }
   return dropped;
+}
+
+// ---------------------------------------------------------------------------
+// Stable counting argsort (bounded keys)
+// ---------------------------------------------------------------------------
+// keys[n] non-negative int32 in [0, key_max]; out[n] receives the
+// permutation with keys[out] ascending, ties in original order —
+// bit-identical to np.argsort(kind="stable"). The layout builder's two
+// entry-stream sorts (tier grouping, heavy-row grouping) are over keys
+// bounded by tier count / row count, so a single counting pass replaces
+// numpy's single-threaded comparison sort (the dominant host cost of a
+// 100M-rating layout build). Parallel scheme: per-thread histograms over
+// contiguous chunks, (key-major, thread-minor) exclusive scan so each
+// thread owns a stable output range per key, then an in-order scatter.
+// Returns 0, or -1 on a key outside [0, key_max].
+int32_t pio_counting_argsort_i32(const int32_t* keys, int64_t n,
+                                 int64_t key_max, int64_t* out) {
+  if (n < 0 || key_max < 0) return -1;
+  if (n == 0) return 0;
+  const int64_t nk = key_max + 1;
+  // counting sort only pays when the key space is comparable to n; a
+  // huge sparse key space belongs to a comparison sort (numpy fallback)
+  if (nk > (int64_t{1} << 26) || nk > 4 * n + 1024) return -1;
+  unsigned hw = std::thread::hardware_concurrency();
+  int64_t nt = hw ? static_cast<int64_t>(hw) : 4;
+  nt = std::min<int64_t>(nt, 8);
+  if (n < (1 << 16)) nt = 1;
+  // bound total histogram memory (nt * nk int64s) to ~512 MB
+  while (nt > 1 && nt * nk > (int64_t{1} << 26)) nt /= 2;
+  const int64_t chunk = (n + nt - 1) / nt;
+  std::vector<int64_t> hist;
+  try {
+    hist.assign(static_cast<size_t>(nt) * nk, 0);
+  } catch (const std::bad_alloc&) {
+    return -1;  // caller falls back to numpy; never abort through ctypes
+  }
+  std::atomic<int32_t> bad{0};
+
+  auto count_range = [&](int64_t t) {
+    int64_t* h = hist.data() + t * nk;
+    const int64_t lo = t * chunk, hi = std::min(n, (t + 1) * chunk);
+    for (int64_t i = lo; i < hi; ++i) {
+      const int32_t k = keys[i];
+      if (k < 0 || k > key_max) {
+        bad.store(1, std::memory_order_relaxed);
+        return;
+      }
+      ++h[k];
+    }
+  };
+  {
+    std::vector<std::thread> ts;
+    for (int64_t t = 1; t < nt; ++t) ts.emplace_back(count_range, t);
+    count_range(0);
+    for (auto& th : ts) th.join();
+  }
+  if (bad.load()) return -1;
+  // exclusive scan in (key, thread) order: thread t's output base for
+  // key k follows every smaller key and every earlier thread's k-count
+  int64_t run = 0;
+  for (int64_t k = 0; k < nk; ++k) {
+    for (int64_t t = 0; t < nt; ++t) {
+      int64_t& h = hist[t * nk + k];
+      const int64_t c = h;
+      h = run;
+      run += c;
+    }
+  }
+  auto scatter_range = [&](int64_t t) {
+    int64_t* h = hist.data() + t * nk;
+    const int64_t lo = t * chunk, hi = std::min(n, (t + 1) * chunk);
+    for (int64_t i = lo; i < hi; ++i) out[h[keys[i]]++] = i;
+  };
+  {
+    std::vector<std::thread> ts;
+    for (int64_t t = 1; t < nt; ++t) ts.emplace_back(scatter_range, t);
+    scatter_range(0);
+    for (auto& th : ts) th.join();
+  }
+  return 0;
 }
 
 // ---------------------------------------------------------------------------

@@ -205,3 +205,57 @@ class TestJsonlScanner:
         e = event_from_api_dict(dicts[0])
         assert e.target_entity_id == "i9"
         assert e.properties["rating"] == 3.0
+
+
+class TestCountingArgsort:
+    """Native parallel counting argsort — must be BIT-IDENTICAL to
+    np.argsort(kind="stable") (the layout permutation feeds the training
+    math; any divergence reorders factors)."""
+
+    def test_matches_numpy_stable(self):
+        from predictionio_tpu.native import available, counting_argsort
+
+        if not available():
+            import pytest
+
+            pytest.skip("native lib unavailable")
+        rng = np.random.default_rng(0)
+        for n, kmax in ((0, 5), (1, 0), (1000, 3), (100_000, 17),
+                        (300_000, 100_000)):
+            keys = rng.integers(0, kmax + 1, n).astype(np.int32)
+            got = counting_argsort(keys, kmax)
+            np.testing.assert_array_equal(
+                got, np.argsort(keys, kind="stable"),
+                err_msg=f"n={n} kmax={kmax}")
+
+    def test_out_of_range_returns_none(self):
+        from predictionio_tpu.native import available, counting_argsort
+
+        if not available():
+            import pytest
+
+            pytest.skip("native lib unavailable")
+        assert counting_argsort(np.array([0, 5], np.int32), 3) is None
+        assert counting_argsort(np.array([-1, 0], np.int32), 3) is None
+
+    def test_layout_identical_with_and_without_native(self, monkeypatch):
+        """The full bilinear layout must not depend on which argsort ran."""
+        import predictionio_tpu.ops.neighbors as nb
+
+        rng = np.random.default_rng(3)
+        n, nu, ni = 20_000, 300, 150
+        rows = rng.integers(0, nu, n).astype(np.int64)
+        cols = rng.integers(0, ni, n).astype(np.int64)
+        vals = rng.random(n).astype(np.float32)
+        # a few heavy rows to exercise the chunked path's sort too
+        rows[: n // 4] = 7
+        a_u, a_i = nb.build_bilinear_layout(rows, cols, vals, nu, ni)
+        monkeypatch.setattr(nb, "_stable_argsort_bounded",
+                            lambda k, m: np.argsort(k, kind="stable"))
+        b_u, b_i = nb.build_bilinear_layout(rows, cols, vals, nu, ni)
+        for a, b in ((a_u, b_u), (a_i, b_i)):
+            assert len(a.buckets) == len(b.buckets)
+            for ba, bb in zip(a.buckets, b.buckets):
+                np.testing.assert_array_equal(ba.ids, bb.ids)
+                np.testing.assert_array_equal(ba.vals, bb.vals)
+            np.testing.assert_array_equal(a.pos, b.pos)
