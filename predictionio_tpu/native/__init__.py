@@ -188,7 +188,15 @@ def counting_argsort(keys: np.ndarray, key_max: int) -> np.ndarray | None:
     lib = _load()
     if lib is None:
         return None
-    keys = np.ascontiguousarray(keys, np.int32)
+    keys = np.asarray(keys)
+    if keys.dtype != np.int32:
+        # guard BEFORE the cast: wrapping an out-of-range int64 into
+        # int32 would pass the native range check with a wrong key and
+        # return a silently wrong permutation instead of None
+        if len(keys) and (keys.min() < 0 or keys.max() > key_max):
+            return None
+        keys = keys.astype(np.int32)
+    keys = np.ascontiguousarray(keys)
     out = np.empty(len(keys), np.int64)
     if lib.pio_counting_argsort_i32(keys, len(keys), int(key_max), out) != 0:
         return None

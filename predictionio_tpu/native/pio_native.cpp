@@ -22,6 +22,7 @@
 #include <cstring>
 #include <algorithm>
 #include <atomic>
+#include <system_error>
 #include <thread>
 #include <utility>
 #include <vector>
@@ -189,12 +190,22 @@ int32_t pio_counting_argsort_i32(const int32_t* keys, int64_t n,
       ++h[k];
     }
   };
-  {
+  // run [1, nt) on spawned threads, chunk 0 inline; thread-resource
+  // exhaustion degrades to running the chunk inline (never lets
+  // std::system_error escape the C ABI and terminate the process)
+  auto parallel_for = [&](auto&& fn) {
     std::vector<std::thread> ts;
-    for (int64_t t = 1; t < nt; ++t) ts.emplace_back(count_range, t);
-    count_range(0);
+    for (int64_t t = 1; t < nt; ++t) {
+      try {
+        ts.emplace_back(fn, t);
+      } catch (const std::system_error&) {
+        fn(t);
+      }
+    }
+    fn(0);
     for (auto& th : ts) th.join();
-  }
+  };
+  parallel_for(count_range);
   if (bad.load()) return -1;
   // exclusive scan in (key, thread) order: thread t's output base for
   // key k follows every smaller key and every earlier thread's k-count
@@ -212,12 +223,7 @@ int32_t pio_counting_argsort_i32(const int32_t* keys, int64_t n,
     const int64_t lo = t * chunk, hi = std::min(n, (t + 1) * chunk);
     for (int64_t i = lo; i < hi; ++i) out[h[keys[i]]++] = i;
   };
-  {
-    std::vector<std::thread> ts;
-    for (int64_t t = 1; t < nt; ++t) ts.emplace_back(scatter_range, t);
-    scatter_range(0);
-    for (auto& th : ts) th.join();
-  }
+  parallel_for(scatter_range);
   return 0;
 }
 

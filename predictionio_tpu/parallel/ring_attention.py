@@ -36,23 +36,26 @@ __all__ = [
 _NEG = -1e30
 
 
-def _block_attn(q, k, v, scale, mask):
-    """One [Lq, Lk] score block -> (scores_max, exp-weights @ v, exp-sum).
-
-    q: [B, Lq, H, D]; k, v: [B, Lk, H, D]; mask: [Lq, Lk] bool or None.
-    Returns (m, pv, l): m [B, H, Lq], pv [B, Lq, H, D], l [B, H, Lq].
-    """
+def _block_attn_bhld(qt, k_blk, v_blk, scale, mask, mm_dtype):
+    """One [Lq, Lk] score block in [B, H, L, D] layout -> (scores_max,
+    exp-weights @ v, exp-sum): m [B, H, Lq], pv [B, H, Lq, D] f32,
+    l [B, H, Lq] f32. Matmuls stay in ``mm_dtype`` with f32 accumulation
+    (``preferred_element_type``); the softmax pieces are f32 — the tuned
+    formulation shared with ``blockwise_attention`` (measured 8x the old
+    [B, L, H, D] f32 einsums on v5e)."""
     import jax.numpy as jnp
 
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # [B, H, Lq, Lk]
+    f32 = jnp.float32
+    s = jnp.einsum("bhld,bhsd->bhls", qt, k_blk,
+                   preferred_element_type=f32) * scale
     if mask is not None:
-        s = jnp.where(mask[None, None, :, :], s, _NEG)
-    m = jnp.max(s, axis=-1)  # [B, H, Lq]
-    p = jnp.exp(s - m[..., None])  # [B, H, Lq, Lk]
-    # zero out fully-masked rows (exp(_NEG - _NEG) = 1 garbage)
-    p = jnp.where((m > _NEG / 2)[..., None], p, 0.0)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
-    l = jnp.sum(p, axis=-1)  # noqa: E741
+        s = jnp.where(mask[None, None], s, _NEG)
+    m = s.max(-1)  # [B, H, Lq]
+    # fully-masked rows: keep them at exp(_NEG) ≈ 0, not exp(0)
+    p = jnp.exp(s - jnp.where(m > _NEG / 2, m, 0.0)[..., None])
+    l = p.sum(-1)  # noqa: E741
+    pv = jnp.einsum("bhls,bhsd->bhld", p.astype(mm_dtype), v_blk,
+                    preferred_element_type=f32)
     return m, pv, l
 
 
@@ -63,18 +66,25 @@ def ring_attention(q, k, v, axis_name: str = "seq", *, causal: bool = False):
 
     q, k, v: [B, L_local, H, D] per-device blocks of a global [B, L, H, D].
     Causal masking uses *global* positions: device p's Q block covers
-    positions [p*L_local, (p+1)*L_local).
+    positions [p*L_local, (p+1)*L_local). Internally runs in [B, H, L, D]
+    layout with input-dtype matmuls and f32 carries (the tuned
+    formulation of ``blockwise_attention``); returns q.dtype.
     """
     import jax
     import jax.numpy as jnp
 
     B, Lq, H, D = q.shape
     Lk = k.shape[1]
+    f32 = jnp.float32
     scale = 1.0 / (D**0.5)
     n = jax.lax.psum(1, axis_name)
     p_idx = jax.lax.axis_index(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
+    mm_dtype = q.dtype if q.dtype == jnp.bfloat16 else f32
+    qt = jnp.transpose(q, (0, 2, 1, 3)).astype(mm_dtype)
+    kt = jnp.transpose(k, (0, 2, 1, 3)).astype(mm_dtype)
+    vt = jnp.transpose(v, (0, 2, 1, 3)).astype(mm_dtype)
     q_pos = p_idx * Lq + jnp.arange(Lq)  # global positions of our queries
 
     def body(i, carry):
@@ -86,25 +96,26 @@ def ring_attention(q, k, v, axis_name: str = "seq", *, causal: bool = False):
             mask = k_pos[None, :] <= q_pos[:, None]
         else:
             mask = None
-        bm, bpv, bl = _block_attn(q, k_blk, v_blk, scale, mask)
+        bm, bpv, bl = _block_attn_bhld(qt, k_blk, v_blk, scale, mask,
+                                       mm_dtype)
         m_new = jnp.maximum(m, bm)
         # rescale both accumulators to the new max; guard all-masked rows
         alpha = jnp.exp(jnp.where(m > _NEG / 2, m - m_new, 0.0))
         beta = jnp.exp(jnp.where(bm > _NEG / 2, bm - m_new, 0.0))
-        acc = acc * alpha.transpose(0, 2, 1)[..., None] + bpv * beta.transpose(0, 2, 1)[..., None]
+        acc = acc * alpha[..., None] + bpv * beta[..., None]
         l = l * alpha + bl * beta  # noqa: E741
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
         return k_blk, v_blk, m_new, acc, l
 
-    m0 = jnp.full((B, H, Lq), _NEG, q.dtype)
-    acc0 = jnp.zeros((B, Lq, H, D), q.dtype)
-    l0 = jnp.zeros((B, H, Lq), q.dtype)
+    m0 = jnp.full((B, H, Lq), _NEG, f32)
+    acc0 = jnp.zeros((B, H, Lq, D), f32)
+    l0 = jnp.zeros((B, H, Lq), f32)
     _, _, _, acc, l = jax.lax.fori_loop(  # noqa: E741
-        0, n, body, (k, v, m0, acc0, l0)
+        0, n, body, (kt, vt, m0, acc0, l0)
     )
-    denom = jnp.maximum(l, 1e-20).transpose(0, 2, 1)[..., None]
-    return acc / denom
+    out = acc / jnp.maximum(l, 1e-20)[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)
 
 
 def blockwise_attention(q, k, v, *, causal: bool = False, block_size: int = 1024):
@@ -145,8 +156,6 @@ def blockwise_attention(q, k, v, *, causal: bool = False, block_size: int = 1024
         m, acc, l = carry  # noqa: E741
         k_blk = jax.lax.dynamic_index_in_dim(kr, i, 2, keepdims=False)
         v_blk = jax.lax.dynamic_index_in_dim(vr, i, 2, keepdims=False)
-        s = jnp.einsum("bhld,bhsd->bhls", qt, k_blk,
-                       preferred_element_type=f32) * scale
         k_pos = i * bs + jnp.arange(bs)
         mask = None
         if L_pad != L:
@@ -154,15 +163,8 @@ def blockwise_attention(q, k, v, *, causal: bool = False, block_size: int = 1024
         if causal:
             cm = k_pos[None, :] <= q_pos[:, None]
             mask = cm if mask is None else mask & cm
-        if mask is not None:
-            s = jnp.where(mask[None, None], s, _NEG)
-        bm = s.max(-1)  # [B, H, L]
-        # fully-masked rows: bm = _NEG; subtracting it would turn the
-        # masked exp(_NEG - _NEG) into 1 — keep them at exp(_NEG) ≈ 0
-        p = jnp.exp(s - jnp.where(bm > _NEG / 2, bm, 0.0)[..., None])
-        bl = p.sum(-1)
-        pv = jnp.einsum("bhls,bhsd->bhld", p.astype(mm_dtype), v_blk,
-                        preferred_element_type=f32)
+        bm, pv, bl = _block_attn_bhld(qt, k_blk, v_blk, scale, mask,
+                                      mm_dtype)
         m_new = jnp.maximum(m, bm)
         alpha = jnp.exp(jnp.where(m > _NEG / 2, m - m_new, 0.0))
         beta = jnp.exp(jnp.where(bm > _NEG / 2, bm - m_new, 0.0))
@@ -220,8 +222,8 @@ def ring_self_attention(mesh, q, k, v, *, causal: bool = False,
     shard_map = get_shard_map()
 
     if seq_axis not in mesh.shape or mesh.shape[seq_axis] == 1:
-        return blockwise_attention(q, k, v, causal=causal,
-                                   block_size=max(1, q.shape[1]))
+        # no sequence axis: the tuned single-device path (Pallas on TPU)
+        return flash_attention(q, k, v, causal=causal)
     b_ax = batch_axis if (batch_axis and batch_axis in mesh.shape) else None
     spec = P(b_ax, seq_axis, None, None)
     fn = shard_map(
@@ -243,7 +245,6 @@ def ulysses_attention(q, k, v, axis_name: str = "seq", *, causal: bool = False):
     inside shard_map with seq dim sharded over ``axis_name``; H must be
     divisible by the axis size."""
     import jax
-    import jax.numpy as jnp
 
     n = jax.lax.psum(1, axis_name)
     H = q.shape[2]
@@ -264,12 +265,9 @@ def ulysses_attention(q, k, v, axis_name: str = "seq", *, causal: bool = False):
                                   tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    B, L, Hl, D = qh.shape
-    scale = 1.0 / (D**0.5)
-    s = jnp.einsum("bqhd,bkhd->bhqk", qh, kh) * scale
-    if causal:
-        pos = jnp.arange(L)
-        s = jnp.where(pos[None, :] <= pos[:, None], s, _NEG)
-    p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p, vh)
-    return heads_to_seq(out)
+    # local attention over the FULL sequence via the tuned flash-style
+    # path — the naive [B, H/n, L, L] logits tensor this replaces is
+    # exactly the long-context memory wall sequence parallelism exists
+    # to break (L=16k f32 would be ~8.6 GB per 8 local heads)
+    out = blockwise_attention(qh, kh, vh, causal=causal)
+    return heads_to_seq(out.astype(q.dtype))

@@ -259,3 +259,17 @@ class TestCountingArgsort:
                 np.testing.assert_array_equal(ba.ids, bb.ids)
                 np.testing.assert_array_equal(ba.vals, bb.vals)
             np.testing.assert_array_equal(a.pos, b.pos)
+
+    def test_int64_out_of_range_returns_none(self):
+        """int64 keys outside int32 must NOT wrap into range (review r4:
+        a wrapped key passes the native check and returns a silently
+        wrong permutation; the contract is None -> numpy fallback)."""
+        from predictionio_tpu.native import available, counting_argsort
+
+        if not available():
+            import pytest
+
+            pytest.skip("native lib unavailable")
+        assert counting_argsort(np.array([2**32, 1], np.int64), 3) is None
+        got = counting_argsort(np.array([2, 0, 1], np.int64), 2)
+        np.testing.assert_array_equal(got, [1, 2, 0])
