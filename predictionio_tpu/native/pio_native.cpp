@@ -43,6 +43,32 @@ inline uint64_t subsample_key(uint64_t seed, uint64_t row, uint64_t pos) {
   return splitmix64(splitmix64(seed + row) + pos);
 }
 
+// Thread count for data-parallel host passes; 1 for small inputs.
+inline int64_t thread_count(int64_t n) {
+  if (n < (int64_t{1} << 16)) return 1;
+  unsigned hw = std::thread::hardware_concurrency();
+  int64_t nt = hw ? static_cast<int64_t>(hw) : 4;
+  return std::min<int64_t>(nt, 8);
+}
+
+// Run fn(t) for t in [0, nt): [1, nt) on spawned threads, chunk 0
+// inline. Thread-resource exhaustion degrades to inline execution —
+// std::system_error must never escape the C ABI (std::terminate would
+// kill the embedding Python process instead of falling back to numpy).
+template <typename F>
+inline void run_parallel(int64_t nt, F&& fn) {
+  std::vector<std::thread> ts;
+  for (int64_t t = 1; t < nt; ++t) {
+    try {
+      ts.emplace_back(fn, t);
+    } catch (const std::system_error&) {
+      fn(t);
+    }
+  }
+  fn(0);
+  for (auto& th : ts) th.join();
+}
+
 }  // namespace
 
 extern "C" {
@@ -62,14 +88,38 @@ int64_t pio_neighbor_blocks(const int64_t* rows, const int32_t* cols,
                             int64_t d, uint64_t seed, int32_t* ids_out,
                             float* vals_out, float* mask_out) {
   if (n < 0 || num_rows < 0 || d <= 0) return -1;
+  // per-thread row histograms: the count AND the stable scatter both
+  // parallelize with per-(thread, row) write bases — the layout builder
+  // calls this per tier over pre-grouped entries, so the no-overflow
+  // path below carries ~all of a 100M-rating build's fill cost
+  int64_t nt = thread_count(n);
+  while (nt > 1 && nt * num_rows > (int64_t{1} << 26)) nt /= 2;
+  const int64_t chunk = (n + nt - 1) / nt;
+  // every allocation is inside this try: bad_alloc must surface as -1
+  // (numpy fallback), never std::terminate through the C ABI
+  try {
+  std::vector<int64_t> tcounts(static_cast<size_t>(nt) * num_rows, 0);
+  std::atomic<int32_t> bad{0};
+  run_parallel(nt, [&](int64_t t) {
+    int64_t* c = tcounts.data() + t * num_rows;
+    const int64_t lo = t * chunk, hi = std::min(n, (t + 1) * chunk);
+    for (int64_t i = lo; i < hi; ++i) {
+      int64_t r = rows[i];
+      if (r < 0 || r >= num_rows) {
+        bad.store(1, std::memory_order_relaxed);
+        return;
+      }
+      ++c[r];
+    }
+  });
+  if (bad.load()) return -1;
+
   std::vector<int64_t> counts(static_cast<size_t>(num_rows), 0);
-  for (int64_t i = 0; i < n; ++i) {
-    int64_t r = rows[i];
-    if (r < 0 || r >= num_rows) return -1;
-    counts[static_cast<size_t>(r)]++;
+  for (int64_t t = 0; t < nt; ++t) {
+    const int64_t* c = tcounts.data() + t * num_rows;
+    for (int64_t r = 0; r < num_rows; ++r) counts[r] += c[r];
   }
 
-  std::vector<int64_t> cursor(static_cast<size_t>(num_rows), 0);
   int64_t dropped = 0;
 
   // Overflow rows need a per-row selection; collect their entry indices.
@@ -80,15 +130,32 @@ int64_t pio_neighbor_blocks(const int64_t* rows, const int32_t* cols,
     if (counts[static_cast<size_t>(r)] > d) overflow_rows.push_back(r);
 
   if (overflow_rows.empty()) {
-    for (int64_t i = 0; i < n; ++i) {
-      int64_t r = rows[i];
-      int64_t slot = r * d + cursor[static_cast<size_t>(r)]++;
-      ids_out[slot] = cols[i];
-      vals_out[slot] = vals[i];
-      if (mask_out) mask_out[slot] = 1.0f;
+    // per-(thread, row) write base: row r's d-slot block is filled by
+    // threads in chunk order, each thread's entries in stream order —
+    // the same stable layout as the sequential fill
+    for (int64_t r = 0; r < num_rows; ++r) {
+      int64_t running = r * d;
+      for (int64_t t = 0; t < nt; ++t) {
+        int64_t& c = tcounts[t * num_rows + r];
+        const int64_t cnt = c;
+        c = running;
+        running += cnt;
+      }
     }
+    run_parallel(nt, [&](int64_t t) {
+      int64_t* base = tcounts.data() + t * num_rows;
+      const int64_t lo = t * chunk, hi = std::min(n, (t + 1) * chunk);
+      for (int64_t i = lo; i < hi; ++i) {
+        const int64_t slot = base[rows[i]]++;
+        ids_out[slot] = cols[i];
+        vals_out[slot] = vals[i];
+        if (mask_out) mask_out[slot] = 1.0f;
+      }
+    });
     return 0;
   }
+
+  std::vector<int64_t> cursor(static_cast<size_t>(num_rows), 0);
 
   // Mark overflow membership for O(1) routing in the scatter pass.
   std::vector<int64_t> overflow_slot(static_cast<size_t>(num_rows), -1);
@@ -140,6 +207,9 @@ int64_t pio_neighbor_blocks(const int64_t* rows, const int32_t* cols,
     dropped += cnt - d;
   }
   return dropped;
+  } catch (const std::bad_alloc&) {
+    return -1;
+  }
 }
 
 // ---------------------------------------------------------------------------
@@ -163,10 +233,7 @@ int32_t pio_counting_argsort_i32(const int32_t* keys, int64_t n,
   // counting sort only pays when the key space is comparable to n; a
   // huge sparse key space belongs to a comparison sort (numpy fallback)
   if (nk > (int64_t{1} << 26) || nk > 4 * n + 1024) return -1;
-  unsigned hw = std::thread::hardware_concurrency();
-  int64_t nt = hw ? static_cast<int64_t>(hw) : 4;
-  nt = std::min<int64_t>(nt, 8);
-  if (n < (1 << 16)) nt = 1;
+  int64_t nt = thread_count(n);
   // bound total histogram memory (nt * nk int64s) to ~512 MB
   while (nt > 1 && nt * nk > (int64_t{1} << 26)) nt /= 2;
   const int64_t chunk = (n + nt - 1) / nt;
@@ -190,22 +257,7 @@ int32_t pio_counting_argsort_i32(const int32_t* keys, int64_t n,
       ++h[k];
     }
   };
-  // run [1, nt) on spawned threads, chunk 0 inline; thread-resource
-  // exhaustion degrades to running the chunk inline (never lets
-  // std::system_error escape the C ABI and terminate the process)
-  auto parallel_for = [&](auto&& fn) {
-    std::vector<std::thread> ts;
-    for (int64_t t = 1; t < nt; ++t) {
-      try {
-        ts.emplace_back(fn, t);
-      } catch (const std::system_error&) {
-        fn(t);
-      }
-    }
-    fn(0);
-    for (auto& th : ts) th.join();
-  };
-  parallel_for(count_range);
+  run_parallel(nt, count_range);
   if (bad.load()) return -1;
   // exclusive scan in (key, thread) order: thread t's output base for
   // key k follows every smaller key and every earlier thread's k-count
@@ -223,7 +275,7 @@ int32_t pio_counting_argsort_i32(const int32_t* keys, int64_t n,
     const int64_t lo = t * chunk, hi = std::min(n, (t + 1) * chunk);
     for (int64_t i = lo; i < hi; ++i) out[h[keys[i]]++] = i;
   };
-  parallel_for(scatter_range);
+  run_parallel(nt, scatter_range);
   return 0;
 }
 

@@ -36,6 +36,20 @@ __all__ = [
 _NEG = -1e30
 
 
+def _merge_carry(m, acc, l, bm, pv, bl):  # noqa: E741 - l is the flash sum
+    """Fold one block's (bm, pv, bl) into the running flash-softmax carry
+    (m, acc, l): rescale both sides to the new running max, guarding
+    never-touched rows (m = _NEG) against exp(_NEG - _NEG) = 1. Shared by
+    the ring and blockwise loops so their numerics cannot diverge."""
+    import jax.numpy as jnp
+
+    m_new = jnp.maximum(m, bm)
+    alpha = jnp.exp(jnp.where(m > _NEG / 2, m - m_new, 0.0))
+    beta = jnp.exp(jnp.where(bm > _NEG / 2, bm - m_new, 0.0))
+    acc = acc * alpha[..., None] + pv * beta[..., None]
+    return m_new, acc, l * alpha + bl * beta
+
+
 def _block_attn_bhld(qt, k_blk, v_blk, scale, mask, mm_dtype):
     """One [Lq, Lk] score block in [B, H, L, D] layout -> (scores_max,
     exp-weights @ v, exp-sum): m [B, H, Lq], pv [B, H, Lq, D] f32,
@@ -98,12 +112,7 @@ def ring_attention(q, k, v, axis_name: str = "seq", *, causal: bool = False):
             mask = None
         bm, bpv, bl = _block_attn_bhld(qt, k_blk, v_blk, scale, mask,
                                        mm_dtype)
-        m_new = jnp.maximum(m, bm)
-        # rescale both accumulators to the new max; guard all-masked rows
-        alpha = jnp.exp(jnp.where(m > _NEG / 2, m - m_new, 0.0))
-        beta = jnp.exp(jnp.where(bm > _NEG / 2, bm - m_new, 0.0))
-        acc = acc * alpha[..., None] + bpv * beta[..., None]
-        l = l * alpha + bl * beta  # noqa: E741
+        m_new, acc, l = _merge_carry(m, acc, l, bm, bpv, bl)  # noqa: E741
         k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
         v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
         return k_blk, v_blk, m_new, acc, l
@@ -165,12 +174,7 @@ def blockwise_attention(q, k, v, *, causal: bool = False, block_size: int = 1024
             mask = cm if mask is None else mask & cm
         bm, pv, bl = _block_attn_bhld(qt, k_blk, v_blk, scale, mask,
                                       mm_dtype)
-        m_new = jnp.maximum(m, bm)
-        alpha = jnp.exp(jnp.where(m > _NEG / 2, m - m_new, 0.0))
-        beta = jnp.exp(jnp.where(bm > _NEG / 2, bm - m_new, 0.0))
-        acc = acc * alpha[..., None] + pv * beta[..., None]
-        l = l * alpha + bl * beta  # noqa: E741
-        return m_new, acc, l
+        return _merge_carry(m, acc, l, bm, pv, bl)
 
     m0 = jnp.full((B, H, L), _NEG, f32)
     acc0 = jnp.zeros((B, H, L, D), f32)
