@@ -45,6 +45,7 @@ class TwoTowerConfig:
 
 def _make_towers(n_users: int, n_items: int, cfg: TwoTowerConfig):
     import flax.linen as nn
+    import jax
     import jax.numpy as jnp
 
     class Tower(nn.Module):
@@ -59,7 +60,12 @@ def _make_towers(n_users: int, n_items: int, cfg: TwoTowerConfig):
             x = nn.relu(x)
             x = nn.Dense(cfg.out_dim, dtype=jnp.bfloat16)(x)
             x = x.astype(jnp.float32)
-            return x / (jnp.linalg.norm(x, axis=-1, keepdims=True) + 1e-6)
+            # L2 normalize with the epsilon INSIDE the rsqrt: the naive
+            # x / (||x|| + eps) has a NaN gradient at x = 0 (d||x||/dx is
+            # 0/0), and an all-dead-ReLU row really produces x = 0 at
+            # small widths — one such row NaNs the whole batch's step
+            return x * jax.lax.rsqrt(
+                jnp.sum(x * x, axis=-1, keepdims=True) + 1e-12)
 
     return Tower(n_users), Tower(n_items)
 
