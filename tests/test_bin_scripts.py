@@ -1,0 +1,90 @@
+"""bin/ ops-plane scripts — the pio-start-all/pio-stop-all daemon pair
+(reference bin/pio-start-all brings up ES + HBase + event server; here it
+starts the event server, dashboard, and admin API with pidfiles) and the
+`bin/pio` dispatcher. These were the only untested executables."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import time
+from pathlib import Path
+
+import pytest
+import requests
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_start_all_stop_all(tmp_path):
+    env = dict(
+        os.environ,
+        PIO_HOME=str(tmp_path),
+        PIO_EVENTSERVER_PORT=str(_free_port()),
+        PIO_DASHBOARD_PORT=str(_free_port()),
+        PIO_ADMINSERVER_PORT=str(_free_port()),
+        JAX_PLATFORMS="cpu",
+    )
+    out = subprocess.run([str(REPO / "bin" / "pio-start-all")],
+                         capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "eventserver started" in out.stdout
+    try:
+        # pidfiles written and processes alive
+        for name in ("eventserver", "dashboard", "adminserver"):
+            pidfile = tmp_path / "run" / f"{name}.pid"
+            assert pidfile.exists(), f"{name} pidfile missing"
+            os.kill(int(pidfile.read_text()), 0)  # raises if dead
+
+        # the event server actually serves
+        url = f"http://127.0.0.1:{env['PIO_EVENTSERVER_PORT']}"
+        for _ in range(60):
+            try:
+                r = requests.get(url + "/", timeout=2)
+                break
+            except requests.ConnectionError:
+                time.sleep(0.5)
+        else:
+            log = (tmp_path / "log" / "eventserver.log").read_text()
+            pytest.fail(f"event server never came up; log: {log[-800:]}")
+        assert r.json()["status"] == "alive"
+
+        # idempotent restart: already-running services are left alone
+        out2 = subprocess.run([str(REPO / "bin" / "pio-start-all")],
+                              capture_output=True, text=True, env=env,
+                              timeout=60)
+        assert "already running" in out2.stdout
+    finally:
+        out3 = subprocess.run([str(REPO / "bin" / "pio-stop-all")],
+                              capture_output=True, text=True, env=env,
+                              timeout=60)
+    assert out3.returncode == 0
+    assert "eventserver stopped" in out3.stdout
+    # pids really gone
+    time.sleep(0.5)
+    for name in ("eventserver", "dashboard", "adminserver"):
+        assert not (tmp_path / "run" / f"{name}.pid").exists()
+
+    # stop-all on an already-stopped home is a clean no-op
+    out4 = subprocess.run([str(REPO / "bin" / "pio-stop-all")],
+                          capture_output=True, text=True, env=env, timeout=60)
+    assert out4.returncode == 0
+    assert "not running" in out4.stdout
+
+
+def test_pio_dispatcher_version(tmp_path):
+    env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
+    out = subprocess.run([str(REPO / "bin" / "pio"), "version"],
+                         capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    from predictionio_tpu import __version__
+
+    assert __version__ in out.stdout
