@@ -20,7 +20,6 @@ from __future__ import annotations
 
 import dataclasses
 import functools
-from functools import partial
 from typing import Any
 
 import numpy as np

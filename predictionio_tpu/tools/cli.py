@@ -27,7 +27,6 @@ names a Python attribute importable with the engine dir on sys.path.
 from __future__ import annotations
 
 import argparse
-import dataclasses
 import json
 import logging
 import os

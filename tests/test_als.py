@@ -3,7 +3,6 @@ mesh (the reference trusts MLlib for ALS math; we must test ours:
 reconstruction quality, implicit mode, neighbor-block layout, top-N)."""
 
 import numpy as np
-import pytest
 
 from predictionio_tpu.ops.neighbors import build_neighbor_blocks
 from predictionio_tpu.storage.bimap import BiMap
