@@ -78,6 +78,35 @@ def test_blockwise_attention_matches_dense(rng, causal):
                                rtol=1e-5, atol=1e-5)
 
 
+def test_blockwise_attention_bf16_path(rng):
+    """bf16 inputs take the bf16-matmul / f32-accumulation branch
+    (mm_dtype) — pin it against the f32 dense reference at bf16
+    tolerance, and pin the output dtype contract (returns q.dtype)."""
+    import jax.numpy as jnp
+
+    q, k, v = qkv(rng)
+    want = dense_attention(q, k, v, causal=True)
+    qb, kb, vb = (jnp.asarray(x, jnp.bfloat16) for x in (q, k, v))
+    got = blockwise_attention(qb, kb, vb, causal=True, block_size=8)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want), rtol=0.1, atol=0.05)
+
+
+def test_ring_attention_bf16_path(seq_mesh, rng):
+    """The ring's bf16 branch (input-dtype ppermuted K/V blocks, f32
+    carries) must match the f32 dense reference at bf16 tolerance."""
+    import jax.numpy as jnp
+
+    q, k, v = qkv(rng)
+    want = dense_attention(q, k, v, causal=True)
+    qb, kb, vb = (jnp.asarray(x, jnp.bfloat16) for x in (q, k, v))
+    got = ring_self_attention(seq_mesh, qb, kb, vb, causal=True)
+    assert np.asarray(got).dtype == np.float32 or got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want), rtol=0.1, atol=0.05)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 def test_ulysses_matches_dense(seq_mesh, rng, causal):
     q, k, v = qkv(rng)  # H=4 divisible by seq axis 4
