@@ -40,6 +40,11 @@ class TwoTowerConfig:
     epochs: int = 5
     lr: float = 1e-3
     temperature: float = 0.1
+    #: shard the embedding TABLES' vocab rows over the mesh's ``model``
+    #: axis (tensor parallel — tables too big for one chip's HBM). Same
+    #: math as replicated (pinned by tests); silently replicated when the
+    #: mesh has no model axis. The MLP weights stay replicated (tiny).
+    model_sharded: bool = False
     seed: int = 0
 
 
@@ -116,12 +121,39 @@ def make_train_state(n_users: int, n_items: int, cfg: TwoTowerConfig,
     import optax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    user_tower, item_tower = _make_towers(n_users, n_items, cfg)
+    model_sharded = bool(cfg.model_sharded)
+    m_ax = mesh.shape.get("model", 1)
+    if model_sharded and m_ax <= 1:
+        import logging
+
+        logging.getLogger("predictionio_tpu.two_tower").warning(
+            "model_sharded requested but mesh %s has no 'model' axis; "
+            "training with replicated tables", dict(mesh.shape))
+        model_sharded = False
+    # vocab rows pad up to the model axis so arbitrary catalog sizes
+    # shard evenly (the padded rows are never looked up — ids stay in
+    # the real range — and only real rows are read back for serving)
+    pad = (lambda n: -(-n // m_ax) * m_ax) if model_sharded else (lambda n: n)
+    user_tower, item_tower = _make_towers(pad(n_users), pad(n_items), cfg)
     key = jax.random.PRNGKey(cfg.seed)
     ku, ki, kshuf = jax.random.split(key, 3)
     u_params = user_tower.init(ku, jnp.zeros((2,), jnp.int32))
     i_params = item_tower.init(ki, jnp.zeros((2,), jnp.int32))
     params = {"user": u_params, "item": i_params}
+    if model_sharded:
+        # tensor-parallel tables: the Embed kernels' vocab rows shard
+        # over `model`; everything else (tiny MLP weights) replicates.
+        # Committed input shardings propagate through jit, and adam's
+        # moment tensors follow their params' shardings.
+        emb = NamedSharding(mesh, P("model", None))
+        rep = NamedSharding(mesh, P())
+
+        def place(path, leaf):
+            is_table = any(getattr(p, "key", None) == "embedding"
+                           for p in path)
+            return jax.device_put(leaf, emb if is_table else rep)
+
+        params = jax.tree_util.tree_map_with_path(place, params)
     opt = optax.adam(cfg.lr)
     opt_state = opt.init(params)
 

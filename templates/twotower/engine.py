@@ -41,6 +41,8 @@ class AlgorithmParams(Params):
     epochs: int = 5
     lr: float = 1e-3
     temperature: float = 0.1
+    #: shard embedding tables over the mesh's `model` axis (huge catalogs)
+    model_sharded: bool = False
     seed: int = 0
 
 
@@ -103,6 +105,7 @@ class TwoTowerAlgorithm(Algorithm):
             epochs=self.params.epochs,
             lr=self.params.lr,
             temperature=self.params.temperature,
+            model_sharded=self.params.model_sharded,
             seed=self.params.seed,
         )
         return train_two_tower(ratings, cfg, mesh=ctx.mesh)
