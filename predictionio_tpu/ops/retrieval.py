@@ -23,7 +23,8 @@ import functools
 
 import numpy as np
 
-__all__ = ["topk_scores", "DeviceRetriever", "RetrievalServingMixin", "row_normalize"]
+__all__ = ["topk_scores", "DeviceRetriever", "ShardedDeviceRetriever",
+           "RetrievalServingMixin", "row_normalize"]
 
 
 def row_normalize(x: np.ndarray) -> np.ndarray:
@@ -32,6 +33,12 @@ def row_normalize(x: np.ndarray) -> np.ndarray:
     score identically (test_als device/host parity pins it)."""
     x = np.asarray(x, np.float32)
     return x / (np.linalg.norm(x, axis=1, keepdims=True) + 1e-9)
+
+
+#: Largest catalog whose indices are exact in float32 — above it the
+#: packed single-pull result buffer would corrupt indices, so callers
+#: fall back to the two-buffer path. One home for both retrievers.
+PACKED_IDX_LIMIT = 1 << 24
 
 
 def _pad_to(x, mult, axis, value=0.0):
@@ -154,7 +161,7 @@ def _build_call(B, D, N_pad, n_total, k, tile_n, interpret):
     import jax.numpy as jnp
 
     call = _raw_call(B, D, N_pad, n_total, k, tile_n, interpret)
-    if n_total >= 1 << 24:
+    if n_total >= PACKED_IDX_LIMIT:
         return jax.jit(call), False
 
     def packed(q, items):
@@ -223,13 +230,13 @@ def _query_shapes(b: int, k_eff: int, n_total: int) -> tuple[int, int]:
     return b_pad, min(((k_eff + 7) // 8) * 8, n_total)
 
 
-def _run_topk(q: np.ndarray, items_dev, n_total: int, k: int, tile_n: int,
-              interpret: bool):
-    """Shared query-side prep + kernel call + un-pad for ``topk_scores``
-    and ``DeviceRetriever.topk`` (one home so padding/empty-catalog
-    handling cannot drift between the two entry points)."""
-    import jax.numpy as jnp
-
+def _dispatch_topk(q: np.ndarray, n_total: int, k: int, invoke):
+    """Query-side prep + result un-pad shared by EVERY top-k entry point
+    (``topk_scores``, ``DeviceRetriever.topk``, ``ShardedDeviceRetriever
+    .topk``) — one home so padding/empty-catalog/pack handling cannot
+    drift between them. ``invoke(q_padded, k_pad)`` runs the compiled
+    call and returns either a (vals, idx) tuple or the packed
+    [B, 2*k_pad] f32 buffer (detected here by type)."""
     single = q.ndim == 1
     if single:
         q = q[None, :]
@@ -242,19 +249,31 @@ def _run_topk(q: np.ndarray, items_dev, n_total: int, k: int, tile_n: int,
     b_pad, k_pad = _query_shapes(q.shape[0], k_eff, n_total)
     q = _pad_to(q, b_pad, 0)
     q = _pad_to(q, 128, 1)
-    call, is_packed = _build_call(
-        q.shape[0], items_dev.shape[1], items_dev.shape[0], n_total, k_pad,
-        tile_n, interpret,
-    )
+    out, is_packed = invoke(q, k_pad)
     if is_packed:
-        host = np.asarray(call(jnp.asarray(q), items_dev))  # ONE pull
+        host = np.asarray(out)  # packed: ONE pull
         vals = host[:b_orig, :k_eff]
         idx = host[:b_orig, k_pad:k_pad + k_eff].astype(np.int32)
     else:
-        vals, idx = call(jnp.asarray(q), items_dev)
+        vals, idx = out
         vals = np.asarray(vals)[:b_orig, :k_eff]
         idx = np.asarray(idx)[:b_orig, :k_eff]
     return (vals[0], idx[0]) if single else (vals, idx)
+
+
+def _run_topk(q: np.ndarray, items_dev, n_total: int, k: int, tile_n: int,
+              interpret: bool):
+    """Single-device entry: fused Pallas kernel behind ``_dispatch_topk``."""
+    import jax.numpy as jnp
+
+    def invoke(qp, k_pad):
+        call, is_packed = _build_call(
+            qp.shape[0], items_dev.shape[1], items_dev.shape[0], n_total,
+            k_pad, tile_n, interpret,
+        )
+        return call(jnp.asarray(qp), items_dev), is_packed
+
+    return _dispatch_topk(q, n_total, k, invoke)
 
 
 def topk_scores(queries, items, k: int, *, tile_n: int = 512, interpret=None):
@@ -299,6 +318,128 @@ class DeviceRetriever:
         q = np.asarray(queries, dtype=np.float32)
         return _run_topk(q, self._items, self.n_total, k, self._tile_n,
                          self._interpret)
+
+
+class ShardedDeviceRetriever:
+    """Catalog top-k with the item matrix SHARDED over a mesh axis — the
+    serving-plane counterpart of model-parallel training: a catalog too
+    large for one chip's HBM (or co-resident with a model-sharded training
+    job) serves top-N without ever being replicated.
+
+    Communication structure (the point of the design): each device scores
+    its own [N/P, D] shard and reduces it to a local [B, k] candidate set
+    inside ``shard_map``; the only collective is the all-gather of those
+    [B, P*k] candidates for the final merge — O(B*P*k) bytes over ICI,
+    independent of catalog size. No all-reduce, no all-to-all, and the
+    [B, N] score matrix never exists globally (the reference's analog
+    ships whole factor RDD partitions through Spark's shuffle to one
+    driver-side sort, examples/scala-parallel-similarproduct/multi/src/
+    main/scala/ALSAlgorithm.scala:146-200).
+
+    API-compatible with ``DeviceRetriever`` (``topk``, ``n_total``): the
+    serving mixin and micro-batcher use either interchangeably.
+    """
+
+    def __init__(self, items: np.ndarray, mesh, *, axis: str = "model"):
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self._mesh = mesh
+        self._axis = axis
+        self._nshards = int(mesh.shape[axis])
+        it = np.asarray(items, dtype=np.float32)
+        self.n_total, self.dim = it.shape
+        it = _pad_to(it, 128, 1)
+        # row-pad so every shard is equal-sized and lane-aligned
+        it = _pad_to(it, 128 * self._nshards, 0)
+        self._shard_rows = it.shape[0] // self._nshards
+        self._items = jax.device_put(
+            jnp.asarray(it), NamedSharding(mesh, P(axis, None)))
+        self._calls: dict = {}
+
+    def _call_for(self, b_pad: int, k_local: int, k_out: int):
+        key = (b_pad, k_local, k_out)
+        fn = self._calls.pop(key, None)
+        if fn is None:
+            # bounded LRU, like _build_call's lru_cache: a long-lived
+            # server must not accumulate one executable per (B, k) pair,
+            # and the hot serving shape must never be the one evicted
+            while len(self._calls) >= 32:
+                self._calls.pop(next(iter(self._calls)))
+            fn = self._build(b_pad, k_local, k_out)
+        self._calls[key] = fn  # (re)insert at the recent end
+        return fn
+
+    def _build(self, b_pad: int, k_local: int, k_out: int):
+        # k_local: per-shard candidates (<= shard rows; a global top-k_out
+        # set takes at most shard_rows entries from any one shard, so
+        # k_local = min(k_out, shard_rows) is exact, not approximate).
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.collectives import get_shard_map
+
+        axis, n_total, S = self._axis, self.n_total, self._shard_rows
+        shard_map = get_shard_map()
+
+        def local_topk(q, shard):  # q [B, D] replicated; shard [S, D]
+            scores = jax.lax.dot_general(
+                q, shard, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+                precision=jax.lax.Precision.HIGHEST,  # rank-stable vs the
+                # single-device kernel and the host f32 reference
+            )  # [B, S]
+            offset = jax.lax.axis_index(axis) * S
+            cand = offset + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+            scores = jnp.where(cand < n_total, scores, -jnp.inf)
+            v, i = jax.lax.top_k(scores, k_local)
+            return v, jnp.take_along_axis(cand, i, axis=1)
+
+        def run(q, items):
+            v, i = shard_map(
+                local_topk, mesh=self._mesh,
+                in_specs=(P(), P(axis, None)),
+                out_specs=(P(None, axis), P(None, axis)),
+            )(q, items)  # [B, P*k_local] per buffer, sharded over axis
+            # Replicate the candidate sets ONCE before the merge: without
+            # this, the merge's take_along_axis on the sharded index array
+            # lowers as mask + all-reduce (the same GSPMD gather trap the
+            # ALS half-step hit — docs/PERF_NOTES.md "Model-sharded
+            # collectives"). With it, the collective inventory is exactly
+            # the two candidate-sized all-gathers the docstring promises.
+            v = jax.lax.with_sharding_constraint(
+                v, NamedSharding(self._mesh, P()))
+            i = jax.lax.with_sharding_constraint(
+                i, NamedSharding(self._mesh, P()))
+            mv, sel = jax.lax.top_k(v, k_out)
+            mi = jnp.take_along_axis(i, sel, axis=1)
+            mi = jnp.where(jnp.isfinite(mv), mi, -1)
+            if n_total < PACKED_IDX_LIMIT:  # pack: ONE host pull
+                return jnp.concatenate(
+                    [mv, mi.astype(jnp.float32)], axis=1)
+            return mv, mi
+
+        return jax.jit(run, in_shardings=(
+            NamedSharding(self._mesh, P()),
+            NamedSharding(self._mesh, P(axis, None)),
+        ))
+
+    def topk(self, queries, k: int):
+        """(values [B, k], indices [B, k]) — indices -1 beyond catalog.
+        Accepts [D] or [B, D]; exact parity with DeviceRetriever.topk
+        (pinned by test_retrieval.test_sharded_matches_single_device)."""
+        import jax.numpy as jnp
+
+        def invoke(qp, k_pad):
+            k_local = min(k_pad, self._shard_rows)
+            out = self._call_for(qp.shape[0], k_local, k_pad)(
+                jnp.asarray(qp), self._items)
+            return out, self.n_total < PACKED_IDX_LIMIT
+
+        return _dispatch_topk(np.asarray(queries, dtype=np.float32),
+                              self.n_total, k, invoke)
 
 
 class RetrievalServingMixin:
@@ -387,6 +528,14 @@ class RetrievalServingMixin:
         self._retriever = DeviceRetriever(
             getattr(self, self._retrieval_attr), interpret=interpret
         )
+
+    def attach_sharded_retriever(self, mesh, *, axis: str = "model") -> None:
+        """Serve top-N from a catalog SHARDED over ``mesh``'s ``axis`` —
+        same serving surface, ShardedDeviceRetriever underneath. For
+        catalogs past one chip's HBM or deployments co-resident with a
+        model-sharded trainer; /reload swaps it like any retriever."""
+        self._retriever = ShardedDeviceRetriever(
+            getattr(self, self._retrieval_attr), mesh, axis=axis)
 
     def attach_similarity_retriever(self, interpret=None) -> None:
         """Row-NORMALIZED catalog retriever: cosine similar-items serving

@@ -64,10 +64,20 @@ def _to_jsonable(x: Any) -> Any:
 
 @dataclasses.dataclass
 class Deployed:
-    """One rehydrated engine instance (swap unit for hot reload)."""
+    """One rehydrated engine instance (swap unit for hot reload).
+
+    ``retriever_mesh``/``retriever_axis``: when set, catalogs attach
+    SHARDED over that mesh axis (ShardedDeviceRetriever) instead of
+    replicated on one device — and the reload path passes them through,
+    so /reload preserves the sharded configuration rather than silently
+    de-sharding a catalog that was sharded because it exceeds one chip's
+    HBM.
+    """
 
     instance: EngineInstance
     result: TrainResult
+    retriever_mesh: object = None
+    retriever_axis: str = "model"
 
     def __post_init__(self):
         # On TPU backends, move catalog factors device-resident so queries
@@ -76,13 +86,19 @@ class Deployed:
         # the old bundle keeps serving until this one is fully on-device.
         import jax
 
-        if jax.default_backend() != "tpu":
+        if jax.default_backend() != "tpu" and self.retriever_mesh is None:
             return
         for model in self.result.models:
-            attach = getattr(model, "attach_retriever", None)
+            if self.retriever_mesh is not None:
+                attach = getattr(model, "attach_sharded_retriever", None)
+                args = (self.retriever_mesh,)
+                kwargs = {"axis": self.retriever_axis}
+            else:
+                attach = getattr(model, "attach_retriever", None)
+                args, kwargs = (), {}
             if attach is not None:
                 try:
-                    attach()
+                    attach(*args, **kwargs)
                 except Exception:  # pragma: no cover - serving must not die
                     log.exception("device retriever attach failed; "
                                   "serving falls back to host scoring")
@@ -103,13 +119,16 @@ class EngineServer:
         batch_max: int = 64,
         batch_inflight: int = 8,
         engine_dir=None,
+        retriever_mesh=None,
+        retriever_axis: str = "model",
     ):
         self.engine = engine
         self.ctx = ctx or Context(mode="Serving")
         self.engine_dir = engine_dir  # for re-resolving blob classes
         self.deployed = Deployed(
             instance,
-            prepare_deploy(engine, instance, self.ctx, engine_dir=engine_dir))
+            prepare_deploy(engine, instance, self.ctx, engine_dir=engine_dir),
+            retriever_mesh=retriever_mesh, retriever_axis=retriever_axis)
         self.feedback_url = feedback_url
         self.access_key = access_key
         self.start_time = datetime.now(timezone.utc)
@@ -231,7 +250,9 @@ class EngineServer:
         if latest is None:
             raise RuntimeError("no COMPLETED engine instance to reload")
         fresh = Deployed(latest, prepare_deploy(self.engine, latest, self.ctx,
-                                                engine_dir=self.engine_dir))
+                                                engine_dir=self.engine_dir),
+                         retriever_mesh=self.deployed.retriever_mesh,
+                         retriever_axis=self.deployed.retriever_axis)
         self.deployed = fresh  # atomic reference swap
         log.info("Reloaded engine instance %s", latest.id)
         return latest.id

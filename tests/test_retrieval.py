@@ -89,3 +89,123 @@ def test_als_model_retriever_matches_host(rng):
     m2 = pickle.loads(pickle.dumps(m))
     assert getattr(m2, "_retriever", None) is None
     assert m2.recommend_products("u3", 5)
+
+
+# ---------------------------------------------------------------------------
+# ShardedDeviceRetriever: catalog sharded over the 8-device virtual mesh.
+
+
+def _sharded(items, axis_len=8):
+    from predictionio_tpu.ops.retrieval import ShardedDeviceRetriever
+    from predictionio_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh((axis_len,), ("model",))
+    return ShardedDeviceRetriever(items, mesh)
+
+
+@pytest.mark.parametrize("B,N,D,k", [
+    (1, 100, 10, 5),       # catalog smaller than 128*P padding
+    (3, 1303, 32, 10),     # N not divisible by the shard count
+    (8, 2048, 64, 40),     # aligned
+])
+def test_sharded_matches_single_device(rng, B, N, D, k):
+    q = rng.standard_normal((B, D)).astype(np.float32)
+    items = rng.standard_normal((N, D)).astype(np.float32)
+    ret = _sharded(items)
+    vals, idx = ret.topk(q, k)
+    want_v, _ = exact_topk(q, items, k)
+    np.testing.assert_allclose(vals, want_v, rtol=1e-5, atol=1e-5)
+    got_scores = np.take_along_axis(q @ items.T, idx.astype(np.int64), axis=1)
+    np.testing.assert_allclose(got_scores, want_v, rtol=1e-5, atol=1e-5)
+    assert (idx >= 0).all() and (idx < N).all()
+    # single-vector query path
+    v1, i1 = ret.topk(q[0], k)
+    np.testing.assert_allclose(v1, vals[0], rtol=1e-6)
+
+
+def test_sharded_items_actually_sharded(rng):
+    """The catalog must live sharded over the model axis (the capability
+    claim is HBM scaling), and query results must survive k > catalog."""
+    items = rng.standard_normal((1024, 16)).astype(np.float32)
+    ret = _sharded(items)
+    assert len(ret._items.sharding.device_set) == 8
+    assert ret._items.shape[0] % 8 == 0
+    # per-device shard is 1/8 of the padded rows
+    db = ret._items.addressable_shards[0].data
+    assert db.shape[0] == ret._items.shape[0] // 8
+    v, i = ret.topk(rng.standard_normal(16).astype(np.float32), 5000)
+    assert v.shape == (1024,)  # clamped to catalog
+
+
+def test_sharded_collective_inventory(rng):
+    """The compiled sharded top-k must move only the [B, P*k] candidate
+    sets: all-gather(s) bounded by candidate size, and NO all-reduce /
+    all-to-all / reduce-scatter (the score matrix never crosses ICI).
+    Mirrors test_als.test_model_sharded_collective_inventory."""
+    import re
+
+    import jax.numpy as jnp
+
+    items = rng.standard_normal((4096, 32)).astype(np.float32)
+    ret = _sharded(items)
+    b_pad, k_pad = 8, 16
+    fn = ret._call_for(b_pad, k_pad, k_pad)
+    q = jnp.zeros((b_pad, 128), jnp.float32)
+    hlo = fn.lower(q, ret._items).compile().as_text()
+    assert not re.search(r"all-reduce(?!-scatter)", hlo), "unexpected all-reduce"
+    assert "all-to-all" not in hlo, "unexpected all-to-all"
+    assert "reduce-scatter" not in hlo, "unexpected reduce-scatter"
+    gathered = re.findall(r"all-gather\.?\d*\s*=\s*\S*f32\[([\d,]+)\]", hlo)
+    assert gathered, "expected the candidate-merge all-gather"
+    for dims in gathered:
+        size = np.prod([int(x) for x in dims.split(",")])
+        assert size <= 8 * b_pad * 2 * k_pad * 4, (
+            f"all-gather of {dims} exceeds candidate-set scale")
+
+
+def test_sharded_mixin_swaps_in(rng):
+    """attach_sharded_retriever must feed the SAME serving surface
+    (top_n_from_catalog / top_n_batch) the single-device retriever does."""
+    from predictionio_tpu.ops.retrieval import RetrievalServingMixin
+    from predictionio_tpu.parallel.mesh import make_mesh
+    from predictionio_tpu.storage.bimap import BiMap
+
+    class M(RetrievalServingMixin):
+        pass
+
+    m = M()
+    m.item_factors = rng.standard_normal((300, 8)).astype(np.float32)
+    m.item_ids = BiMap.from_iterable(f"i{j}" for j in range(300))
+    q = rng.standard_normal(8).astype(np.float32)
+    host = m.top_n_from_catalog(q, 7)
+    m.attach_sharded_retriever(make_mesh((8,), ("model",)))
+    dev = m.top_n_from_catalog(q, 7)
+    assert [i for i, _ in dev] == [i for i, _ in host]
+    np.testing.assert_allclose([s for _, s in dev], [s for _, s in host],
+                               rtol=1e-5)
+    # MODELDATA serialization must drop the device handle
+    assert "_retriever" not in m.__getstate__()
+
+
+def test_deployed_preserves_sharded_attach(rng):
+    """A Deployed bundle built with retriever_mesh attaches the SHARDED
+    retriever (and the reload path re-passes the mesh — create_server.py
+    reload() — so /reload cannot silently de-shard a catalog)."""
+    from types import SimpleNamespace
+
+    from predictionio_tpu.ops.retrieval import (RetrievalServingMixin,
+                                                ShardedDeviceRetriever)
+    from predictionio_tpu.parallel.mesh import make_mesh
+    from predictionio_tpu.storage.bimap import BiMap
+    from predictionio_tpu.workflow.create_server import Deployed
+
+    class M(RetrievalServingMixin):
+        pass
+
+    m = M()
+    m.item_factors = rng.standard_normal((64, 8)).astype(np.float32)
+    m.item_ids = BiMap.from_iterable(f"i{j}" for j in range(64))
+    mesh = make_mesh((8,), ("model",))
+    d = Deployed(None, SimpleNamespace(models=[m]), retriever_mesh=mesh)
+    assert isinstance(m._retriever, ShardedDeviceRetriever)
+    assert d.retriever_mesh is mesh and d.retriever_axis == "model"
