@@ -38,10 +38,43 @@ def log(msg: str) -> None:
 #: device and the deploy port into the driver's next run.
 _CHILDREN: list = []
 
+#: Set when a mid-run platform wedge is detected. run_child refuses to
+#: spawn once set: an abandoned phase thread (see run_joined) must not
+#: launch fresh children onto a wedged platform — they would outlive the
+#: bench holding the tunneled device / deploy port into the driver's
+#: next run.
+_WEDGED = None  # created lazily (threading import is deferred)
 
-def run_child(cmd, **kwargs) -> "subprocess.CompletedProcess":
+
+def _wedge_event():
+    global _WEDGED
+    if _WEDGED is None:
+        import threading
+
+        _WEDGED = threading.Event()
+    return _WEDGED
+
+
+def kill_children() -> None:
+    """Best-effort process-group kill of every live registered child.
+    Called on wedge detection, by the Watchdog before hard exit, and at
+    normal process exit (abandoned phase threads may have left one)."""
+    for p in list(_CHILDREN):
+        try:
+            os.killpg(p.pid, 9)
+        except (ProcessLookupError, PermissionError, OSError):
+            pass
+
+
+def run_child(cmd, needs_device: bool = False,
+              **kwargs) -> "subprocess.CompletedProcess":
     """subprocess.run with the child registered for watchdog cleanup and
-    its own session (so a kill reaches the whole process group)."""
+    its own session (so a kill reaches the whole process group).
+    ``needs_device``: the child talks to the real accelerator — refused
+    after a wedge (CPU children keep running; that's the point of the
+    graceful path)."""
+    if needs_device and _wedge_event().is_set():
+        raise RuntimeError("platform wedged — refusing to spawn a child")
     timeout = kwargs.pop("timeout", None)
     with subprocess.Popen(cmd, stdout=subprocess.PIPE,
                           stderr=subprocess.PIPE, text=True,
@@ -692,7 +725,8 @@ print("E2E", time.time() - t_all)
 """
     env = dict(os.environ, REPO=os.path.dirname(os.path.abspath(__file__)),
                PIO_XLA_CACHE_DIR=cache_dir)
-    out = run_child([sys.executable, "-c", code], env=env, timeout=1800)
+    out = run_child([sys.executable, "-c", code], env=env, timeout=1800,
+                    needs_device=True)
     for line in out.stdout.splitlines():
         if line.startswith("E2E "):
             s = float(line.split()[1])
@@ -939,6 +973,32 @@ def accuracy_gate(compute_dtype: str = "bfloat16") -> float:
     return gap
 
 
+def run_joined(fn, deadline_s):
+    """Run a secondary bench phase in a worker thread, abandoning it at
+    the deadline: a wedged XLA call cannot be interrupted from Python
+    (see Watchdog), but the MAIN thread can walk away and keep running
+    the phases that don't need the accelerator. Returns
+    ("ok", result) | ("error", exc) | ("timeout", None)."""
+    import threading
+
+    box: dict = {}
+
+    def work():
+        try:
+            box["res"] = fn()
+        except Exception as e:  # noqa: BLE001
+            box["err"] = e
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    t.join(deadline_s)
+    if t.is_alive():
+        return "timeout", None
+    if "err" in box:
+        return "error", box["err"]
+    return "ok", box.get("res") or {}
+
+
 class Watchdog:
     """Mid-run wedge escape hatch. The start-of-run ``device_healthy``
     probe cannot help when the tunneled platform wedges AFTER it passes
@@ -985,13 +1045,9 @@ class Watchdog:
                 log(f"WATCHDOG: phase {name!r} exceeded its deadline — "
                     f"platform likely wedged mid-run; emitting the "
                     f"partial artifact and exiting")
-                for p in list(_CHILDREN):
-                    # orphaned children would keep holding the tunneled
-                    # device / deploy port into the driver's next run
-                    try:
-                        os.killpg(p.pid, 9)
-                    except (ProcessLookupError, PermissionError, OSError):
-                        pass
+                # orphaned children would keep holding the tunneled
+                # device / deploy port into the driver's next run
+                kill_children()
                 try:
                     self._emit(wedged_in=name)
                 finally:
@@ -1035,6 +1091,9 @@ def main() -> None:
                        "floor_config": "float32/cg", **extras},
         }))
 
+    import atexit
+
+    atexit.register(kill_children)
     wd = Watchdog(emit)
     platform = "tpu"
     for attempt in range(4):
@@ -1084,9 +1143,29 @@ def main() -> None:
         value *= n_timed / N_RATINGS
     state["value"] = value
     extras = state["extras"]
+
+    def e2e_section():
+        import glob
+        import shutil
+        import tempfile
+
+        # a run abandoned mid-phase (wedge) leaks its cache dir — sweep
+        # predecessors' leftovers so the leak stays bounded at one
+        for stale in glob.glob(os.path.join(tempfile.gettempdir(),
+                                            "pio_e2e_cache_*")):
+            shutil.rmtree(stale, ignore_errors=True)
+        with tempfile.TemporaryDirectory(prefix="pio_e2e_cache_") as cd:
+            cold = round(e2e_quickstart("cold", cd), 1)
+            warm = round(e2e_quickstart("warm cache", cd), 1)
+        return {"e2e_train_deploy_cold_s": cold, "e2e_train_deploy_s": warm}
+
+    # (name, fn, deadline_s, needs_accelerator). CPU-only phases run in
+    # subprocesses / pure host code and keep producing data after a
+    # mid-run platform wedge — losing them cost r4's first artifact its
+    # vs_baseline (the wedge hit before the cpu floor ever ran).
     sections: list = [
-        ("factor sharding", factor_sharding_bench, 2400),
-        ("event ingest", event_ingest_throughput, 900),
+        ("factor sharding", factor_sharding_bench, 2400, False),
+        ("event ingest", event_ingest_throughput, 900, False),
     ]
     if platform == "tpu":
         # serving latency and the e2e child need the real accelerator
@@ -1094,35 +1173,52 @@ def main() -> None:
         # the quickstart subprocess would hang on a wedged platform)
         sections = [
             ("predict latency",
-             lambda: predict_latency(result["u"], result["v"]), 900),
+             lambda: predict_latency(result["u"], result["v"]), 900, True),
             ("pipelined qps",
-             lambda: pipelined_qps(result["u"], result["v"]), 900),
-            ("catalog-1M latency", catalog_1m_latency, 900),
-            ("two-tower", two_tower_bench, 1200),
-            ("seqrec attention", seqrec_attention_bench, 900),
-            ("scale-100M", scale_bench, 1800),
-        ] + sections
-    for name, fn, deadline_s in sections:
-        try:
-            with wd.phase(name, deadline_s):
-                res = fn()
+             lambda: pipelined_qps(result["u"], result["v"]), 900, True),
+            ("catalog-1M latency", catalog_1m_latency, 900, True),
+            ("two-tower", two_tower_bench, 1200, True),
+            ("seqrec attention", seqrec_attention_bench, 900, True),
+            ("scale-100M", scale_bench, 1800, True),
+        ] + sections + [("e2e quickstart", e2e_section, 1800, True)]
+
+    wedged: str | None = None
+    for name, fn, deadline_s, needs_dev in sections:
+        if wedged and needs_dev:
+            log(f"{name} skipped: platform wedged during {wedged!r}")
+            continue
+        # the Watchdog stays armed as the absolute backstop (e.g. the
+        # worker thread wedging in a way that also blocks this loop),
+        # with margin so the graceful path below always wins the race
+        with wd.phase(name, deadline_s + 900):
+            status, res = run_joined(fn, deadline_s)
+        if status == "ok":
             with state_lock:
                 extras.update(res)
-        except Exception as e:  # noqa: BLE001 — secondary, not load-bearing
-            log(f"{name} unavailable: {e}")
-    if platform == "tpu":
-        try:
-            import tempfile
-
-            with tempfile.TemporaryDirectory(prefix="pio_e2e_cache_") as cd, \
-                    wd.phase("e2e quickstart", 1800):
-                cold = round(e2e_quickstart("cold", cd), 1)
-                warm = round(e2e_quickstart("warm cache", cd), 1)
+            continue
+        if status == "error":
+            log(f"{name} unavailable: {res}")
+        # a wedge can also surface as "error" (a child's own timeout can
+        # win the race against the phase deadline), so probe on both
+        if needs_dev and not device_healthy():
+            wedged = name
+            _wedge_event().set()  # no new children onto a wedged platform
+            kill_children()       # reap any child the phase left wedged
+            log(f"{name} failed and the device probe fails — platform "
+                f"wedged; skipping remaining accelerator phases, CPU "
+                f"phases continue")
             with state_lock:
-                extras["e2e_train_deploy_cold_s"] = cold
-                extras["e2e_train_deploy_s"] = warm
-        except Exception as e:  # noqa: BLE001
-            log(f"e2e quickstart unavailable: {e}")
+                extras["partial"] = (
+                    f"platform wedged during {name!r}; later accelerator "
+                    f"phases skipped, CPU phases completed")
+        elif status == "timeout":
+            # the abandoned thread may still be running on the (healthy)
+            # device — label the artifact so later numbers are read with
+            # that contention in mind instead of silently trusted
+            log(f"{name} exceeded its {deadline_s}s deadline; skipped "
+                f"(device probe still healthy)")
+            with state_lock:
+                extras.setdefault("phase_timeouts", []).append(name)
     try:
         with wd.phase("cpu floor", 2400):
             floor = cpu_floor()

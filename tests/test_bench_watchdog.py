@@ -71,3 +71,26 @@ except subprocess.TimeoutExpired:
     out = subprocess.run([sys.executable, "-c", code], capture_output=True,
                          text=True, timeout=60)
     assert "TIMED-OUT 0" in out.stdout
+
+
+def test_run_joined_abandons_wedged_phase():
+    """The graceful path for a mid-run wedge: run_joined returns control
+    at the deadline so CPU-only phases (and the cpu floor -> vs_baseline)
+    still run, instead of the whole bench hard-exiting."""
+    import time
+
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    t0 = time.monotonic()
+    status, res = bench.run_joined(lambda: time.sleep(30), 0.3)
+    assert status == "timeout" and res is None
+    assert time.monotonic() - t0 < 5
+
+    status, res = bench.run_joined(lambda: {"x": 1}, 10)
+    assert status == "ok" and res == {"x": 1}
+
+    boom = RuntimeError("boom")
+    status, res = bench.run_joined(
+        lambda: (_ for _ in ()).throw(boom), 10)
+    assert status == "error" and res is boom
