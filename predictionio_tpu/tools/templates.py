@@ -31,6 +31,7 @@ _DESCRIPTIONS = {
     "stock": "stock backtest: indicators + regression strategy (scala-stock)",
     "helloworld": "minimal copy-me engine (per-day averages)",
     "customdatasource": "tutorial: ALS from a ratings file — write your own DataSource (scala-parallel-recommendation-custom-datasource)",
+    "filterbycategory": "ALS top-N restricted to the query's item categories (scala-parallel-recommendation filter-by-category)",
     "movielensevaluation": "worked example: k-fold tuning grid, 3-metric leaderboard, best.json + dashboard (scala-local-movielens-evaluation)",
 }
 

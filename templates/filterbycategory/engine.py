@@ -1,0 +1,216 @@
+"""Filter-by-category recommendation engine: ALS top-N restricted to the
+item categories named in the query.
+
+Analog of the reference's filter-by-category variant
+(examples/scala-parallel-recommendation/filter-by-category): item ``$set``
+events carry a ``categories`` list property (DataSource.scala:34), the
+query carries ``categories`` (DataSource.scala:76), train builds a
+category -> item-set map (ALSAlgorithm.scala:63-79), and predict scores
+only items in the union of the requested categories
+(ALSModel.recommendProductsFromCategory, ALSModel.scala:28-33).
+
+TPU-first shape of the filter: the reference filters the factor RDD and
+re-scores on executors per query; here the category map is a dict of
+dense item-index arrays built once at train, and a filtered query scores
+one gathered ``[C, R]`` slice on the host (C = candidate count, usually
+a small fraction of the catalog — exact, no recompilation, no dynamic
+shapes on the device). Unfiltered queries take the device retrieval
+kernel path unchanged.
+
+Query:  {"user": "u3", "num": 4, "categories": ["drama"]}
+Result: {"itemScores": [{"item": "i7", "score": 4.2}, ...]}
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from predictionio_tpu.controller import (
+    Algorithm,
+    DataSource,
+    Engine,
+    FirstServing,
+    Params,
+    Preparator,
+    SanityCheck,
+)
+from predictionio_tpu.models.als import ALSConfig, ALSModel, train_als
+from predictionio_tpu.storage.frame import Ratings
+
+
+@dataclass(frozen=True)
+class DataSourceParams(Params):
+    app_name: str = "MyApp"
+
+
+@dataclass(frozen=True)
+class AlgorithmParams(Params):
+    rank: int = 10
+    num_iterations: int = 10
+    lambda_: float = 0.01
+    seed: int = 3
+
+
+@dataclass(frozen=True)
+class Query:
+    user: str
+    num: int
+    #: restrict recommendations to items in ANY of these categories;
+    #: empty = whole catalog (reference Query, DataSource.scala:74-77)
+    categories: tuple = ()
+
+
+@dataclass(frozen=True)
+class ItemScore:
+    item: str
+    score: float
+
+
+@dataclass(frozen=True)
+class PredictedResult:
+    itemScores: tuple
+
+
+class TrainingData(SanityCheck):
+    def __init__(self, ratings: Ratings, item_categories: dict):
+        self.ratings = ratings
+        #: item dense index -> tuple of category names
+        self.item_categories = item_categories
+
+    def sanity_check(self) -> None:
+        if len(self.ratings) == 0:
+            raise ValueError("No rate/buy events found. Import data first.")
+
+
+class CategoryDataSource(DataSource):
+    """Ratings from rate/buy events plus item categories from the items'
+    aggregated ``$set`` properties (reference DataSource.scala:25-54)."""
+
+    params_class = DataSourceParams
+
+    def read_training(self, ctx) -> TrainingData:
+        store = ctx.event_store()
+        frame = store.find_frame(
+            app_name=self.params.app_name,
+            entity_type="user",
+            event_names=("rate", "buy"),
+            target_entity_type="item",
+        )
+
+        def rating_of(name, props):
+            if name == "rate":
+                v = props.get("rating")
+                return float(v) if v is not None else None
+            return 4.0
+
+        ratings = frame.to_ratings(rating_of=rating_of)
+        props = store.aggregate_properties(
+            app_name=self.params.app_name, entity_type="item")
+        item_categories = {}
+        for entity_id, pm in props.items():
+            row = ratings.item_ids.get(entity_id)
+            if row is None:
+                continue  # unrated items have no factors to score
+            cats = pm.get_or_else("categories", [])
+            if cats:
+                item_categories[row] = tuple(str(c) for c in cats)
+        return TrainingData(ratings, item_categories)
+
+
+class CategoryPreparator(Preparator):
+    def prepare(self, ctx, td: TrainingData) -> TrainingData:
+        return td
+
+
+@dataclass
+class CategoryALSModel:
+    """ALS factors plus the category -> dense-item-index map
+    (reference ALSModel.scala:19-26's ``categoryItemsMap``)."""
+
+    als: ALSModel
+    category_items: dict = field(default_factory=dict)
+
+    def attach_retriever(self, interpret=None) -> None:
+        """Deploy hook (create_server.py): unfiltered queries serve from
+        the device-resident catalog through the fused top-k kernel."""
+        self.als.attach_retriever(interpret)
+
+    def attach_sharded_retriever(self, mesh, *, axis: str = "model") -> None:
+        self.als.attach_sharded_retriever(mesh, axis=axis)
+
+    def recommend(self, user: str, num: int, categories=()) -> list:
+        if not categories:
+            return self.als.recommend_products(user, num)
+        row = self.als.user_ids.get(user)
+        if row is None:
+            return []
+        cand_arrays = [self.category_items[c] for c in categories
+                       if c in self.category_items]
+        if not cand_arrays:
+            return []
+        cand = np.unique(np.concatenate(cand_arrays))
+        sub = self.als.item_factors[cand]  # [C, R] gathered slice
+        scores = sub @ self.als.user_factors[row]
+        k = min(num, len(scores))
+        if k <= 0:
+            return []
+        top = np.argpartition(-scores, k - 1)[:k]
+        top = top[np.argsort(-scores[top])]
+        inv = self.als.item_ids.inverse
+        return [(inv[int(cand[i])], float(scores[i])) for i in top]
+
+
+class CategoryALSAlgorithm(Algorithm):
+    params_class = AlgorithmParams
+    query_class = Query
+
+    def train(self, ctx, pd: TrainingData) -> CategoryALSModel:
+        cfg = ALSConfig(
+            rank=self.params.rank,
+            iterations=self.params.num_iterations,
+            lambda_=self.params.lambda_,
+            seed=self.params.seed,
+        )
+        als = train_als(pd.ratings, cfg, mesh=ctx.mesh,
+                        checkpointer=ctx.checkpointer("als"),
+                        checkpoint_every=ctx.checkpoint_every)
+        by_cat: dict = {}
+        for row, cats in pd.item_categories.items():
+            for c in cats:
+                by_cat.setdefault(c, []).append(row)
+        category_items = {c: np.asarray(sorted(rows), np.int32)
+                          for c, rows in by_cat.items()}
+        return CategoryALSModel(als=als, category_items=category_items)
+
+    def predict(self, model: CategoryALSModel, query: Query) -> PredictedResult:
+        recs = model.recommend(query.user, query.num,
+                               tuple(query.categories or ()))
+        return PredictedResult(
+            itemScores=tuple(ItemScore(item=i, score=s) for i, s in recs))
+
+    def batch_predict(self, model: CategoryALSModel, queries) -> list:
+        """Unfiltered queries ride the fused batched device call;
+        filtered ones score their gathered host slice per query."""
+        plain = [(i, q) for i, q in queries if not q.categories]
+        out = {}
+        if plain:
+            recs = model.als.batch_recommend(
+                [q.user for _, q in plain], [q.num for _, q in plain])
+            for (i, _q), rec in zip(plain, recs):
+                out[i] = PredictedResult(itemScores=tuple(
+                    ItemScore(item=t, score=s) for t, s in rec))
+        for i, q in queries:
+            if q.categories:
+                out[i] = self.predict(model, q)
+        return [(i, out[i]) for i, _ in queries]
+
+
+def engine_factory() -> Engine:
+    return Engine(
+        data_source_classes=CategoryDataSource,
+        preparator_classes=CategoryPreparator,
+        algorithm_classes={"als": CategoryALSAlgorithm},
+        serving_classes=FirstServing,
+    )

@@ -523,3 +523,92 @@ class TestMovieLensEvaluation:
         assert best["algorithmsParams"][0]["params"]["rank"] in (4, 8)
         scores = [ms.score for _ep, ms in res.engine_params_scores]
         assert max(scores) > 0.05  # the grid finds signal, not noise
+
+
+class TestFilterByCategory:
+    def _ingest(self, rng, app):
+        # 14 rated items: even-indexed are "drama", odd are "comedy";
+        # items 12,13 also carry a second category "classic"
+        for i in range(14):
+            cats = ["drama" if i % 2 == 0 else "comedy"]
+            if i >= 12:
+                cats.append("classic")
+            insert(app.id, event="$set", entity_type="item",
+                   entity_id=f"i{i}", props={"categories": cats})
+        # an unrated item's categories must be ignored (no factors)
+        insert(app.id, event="$set", entity_type="item", entity_id="i99",
+               props={"categories": ["drama"]})
+        # a RATED item with $set properties but NO categories field must
+        # not crash training (DataMap.get raises on absent fields)
+        insert(app.id, event="$set", entity_type="item", entity_id="i50",
+               props={"title": "uncategorized"})
+        for u in range(25):
+            insert(app.id, event="rate", entity_type="user",
+                   entity_id=f"u{u}", target_entity_type="item",
+                   target_entity_id="i50",
+                   props={"rating": float(rng.integers(1, 6))})
+        for u in range(25):
+            for i in range(14):
+                if rng.random() < 0.6:
+                    insert(app.id, event="rate", entity_type="user",
+                           entity_id=f"u{u}", target_entity_type="item",
+                           target_entity_id=f"i{i}",
+                           props={"rating": float(rng.integers(1, 6))})
+
+    def test_category_filter(self, rng, mesh8):
+        mod = load_template("filterbycategory")
+        app = setup_app()
+        self._ingest(rng, app)
+        engine = mod.engine_factory()
+        ep = EngineParams(
+            data_source_params=("", mod.DataSourceParams(app_name="MyApp")),
+            algorithm_params_list=(
+                ("als", mod.AlgorithmParams(rank=6, num_iterations=5)),),
+        )
+        result = engine.train(Context(), ep)
+        algo, model = result.algorithms[0], result.models[0]
+
+        # unfiltered == plain ALS top-N
+        full = algo.predict(model, mod.Query(user="u3", num=5))
+        assert len(full.itemScores) == 5
+
+        # filtered: only drama items, ranked by the same scores
+        drama = algo.predict(
+            model, mod.Query(user="u3", num=5, categories=("drama",)))
+        items = [s.item for s in drama.itemScores]
+        assert items and all(int(i[1:]) % 2 == 0 for i in items)
+        assert "i99" not in items  # unrated: no factors, never recommended
+        # scores agree with the unfiltered ranking where they overlap
+        full_scores = {s.item: s.score for s in full.itemScores}
+        for s in drama.itemScores:
+            if s.item in full_scores:
+                np.testing.assert_allclose(s.score, full_scores[s.item],
+                                           rtol=1e-5)
+        # filtered results are the drama-subset of a big unfiltered top-N
+        # (i50 is rated but uncategorized: in the unfiltered list, never
+        # in any category filter)
+        big = algo.predict(model, mod.Query(user="u3", num=15))
+        want = [s.item for s in big.itemScores
+                if int(s.item[1:]) < 14 and int(s.item[1:]) % 2 == 0][:5]
+        assert items == want
+
+        # multi-category union covers everything EXCEPT the uncategorized
+        both = algo.predict(
+            model, mod.Query(user="u3", num=15,
+                             categories=("drama", "comedy")))
+        assert [s.item for s in both.itemScores] == \
+            [s.item for s in big.itemScores if s.item != "i50"]
+        assert "i50" in [s.item for s in big.itemScores]
+        none = algo.predict(
+            model, mod.Query(user="u3", num=5, categories=("nope",)))
+        assert none.itemScores == ()
+
+        # batch path: mixed filtered/unfiltered, order preserved
+        queries = [(0, mod.Query(user="u3", num=5)),
+                   (1, mod.Query(user="u3", num=5, categories=("drama",))),
+                   (2, mod.Query(user="nosuch", num=3))]
+        got = dict(algo.batch_predict(model, queries))
+        assert [s.item for s in got[0].itemScores] == \
+            [s.item for s in full.itemScores]
+        assert [s.item for s in got[1].itemScores] == items
+        assert got[2].itemScores == ()
