@@ -414,8 +414,23 @@ def cmd_deploy(args) -> int:
         batch_max=args.batch_max,
         batch_inflight=args.batch_inflight,
         engine_dir=engine_dir,
+        retriever_mesh=_retriever_mesh(args.retriever_mesh),
     )
     return 0
+
+
+def _retriever_mesh(n: int):
+    """Mesh for catalog-sharded serving (--retriever-mesh N): the item
+    catalog shards over an N-device "model" axis instead of living
+    replicated on one device (ops/retrieval.ShardedDeviceRetriever)."""
+    if not n or n <= 1:
+        return None
+    from ..parallel.mesh import make_mesh
+
+    try:
+        return make_mesh((n,), ("model",))
+    except ValueError as e:  # more shards than devices
+        _die(str(e))
 
 
 def cmd_undeploy(args) -> int:
@@ -606,6 +621,9 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--batch-inflight", type=int, default=8,
                     help="max micro-batches dispatched concurrently "
                          "(pipelines the per-call dispatch round trip)")
+    sp.add_argument("--retriever-mesh", type=int, default=0,
+                    help="shard the serving catalog over this many devices "
+                         "(model axis; 0/1 = single-device catalog)")
 
     sp = sub.add_parser("undeploy")
     sp.add_argument("--ip", default="localhost")

@@ -99,6 +99,11 @@ class Deployed:
             if attach is not None:
                 try:
                     attach(*args, **kwargs)
+                    log.info(
+                        "%s retriever attached to %s",
+                        "sharded" if self.retriever_mesh is not None
+                        else "device",
+                        type(model).__name__)
                 except Exception:  # pragma: no cover - serving must not die
                     log.exception("device retriever attach failed; "
                                   "serving falls back to host scoring")
