@@ -94,3 +94,64 @@ def test_run_joined_abandons_wedged_phase():
     status, res = bench.run_joined(
         lambda: (_ for _ in ()).throw(boom), 10)
     assert status == "error" and res is boom
+
+
+def test_main_wedge_skips_accelerator_phases_only(monkeypatch, capsys):
+    """End-to-end pin of the graceful wedge path through bench.main():
+    a phase wedging mid-run skips the REMAINING accelerator phases but
+    the CPU phases (and the cpu floor -> vs_baseline) still run, and the
+    artifact carries the partial label."""
+    import json as json_mod
+    import time
+
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    calls = {"probe": 0}
+
+    def fake_probe(timeout_s=180):
+        calls["probe"] += 1
+        return calls["probe"] == 1  # healthy at startup, wedged on re-probe
+
+    monkeypatch.setattr(bench, "device_healthy", fake_probe)
+    monkeypatch.setattr(bench, "enable_compile_cache", lambda: None)
+    monkeypatch.setattr(bench, "accuracy_gate", lambda compute_dtype: 1e-5)
+    monkeypatch.setattr(bench, "run_bench",
+                        lambda n, iters, kind, compute_dtype: {
+                            "iters_per_sec": 5.0, "hbm_util_pct": 80.0,
+                            "hbm_gbps": 600, "traffic_gb_per_iter": 100.0,
+                            "u": None, "v": None})
+    monkeypatch.setattr(bench, "predict_latency",
+                        lambda u, v: {"predict_p50_ms": 70.0})
+    monkeypatch.setattr(bench, "pipelined_qps",
+                        lambda u, v: {"pipelined_qps_depth8": 6000})
+    monkeypatch.setattr(bench, "catalog_1m_latency",
+                        lambda: {"catalog_1m_p50_ms": 80.0})
+    monkeypatch.setattr(bench, "two_tower_bench",
+                        lambda: time.sleep(30))          # the wedge
+    monkeypatch.setattr(bench, "seqrec_attention_bench",
+                        lambda: {"seqrec": 1})           # must be SKIPPED
+    monkeypatch.setattr(bench, "scale_bench", lambda: {"scale": 1})
+    monkeypatch.setattr(bench, "e2e_quickstart", lambda *a: 1.0)
+    monkeypatch.setattr(bench, "factor_sharding_bench",
+                        lambda: {"sharding_8x1": 2.4})   # CPU: must RUN
+    monkeypatch.setattr(bench, "event_ingest_throughput",
+                        lambda: {"ingest_eps": 15000})   # CPU: must RUN
+    monkeypatch.setattr(bench, "cpu_floor", lambda: 0.5)
+    orig = bench.run_joined
+    monkeypatch.setattr(bench, "run_joined",
+                        lambda fn, dl: orig(fn, min(dl, 1)))
+    # the wedge flag is process-global: reset it after the test so other
+    # in-process users of run_child(needs_device=True) are unaffected
+    monkeypatch.setattr(bench, "_WEDGED", None)
+
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    j = json_mod.loads(out)
+    cfg = j["config"]
+    assert j["vs_baseline"] == 10.0
+    assert "wedged" in cfg["partial"]
+    assert cfg["sharding_8x1"] == 2.4 and cfg["ingest_eps"] == 15000
+    assert "seqrec" not in cfg and "scale" not in cfg
+    assert "e2e_train_deploy_s" not in cfg
+    assert cfg["predict_p50_ms"] == 70.0
