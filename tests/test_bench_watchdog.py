@@ -101,7 +101,6 @@ def test_main_wedge_skips_accelerator_phases_only(monkeypatch, capsys):
     a phase wedging mid-run skips the REMAINING accelerator phases but
     the CPU phases (and the cpu floor -> vs_baseline) still run, and the
     artifact carries the partial label."""
-    import json as json_mod
     import time
 
     sys.path.insert(0, str(REPO))
@@ -128,7 +127,7 @@ def test_main_wedge_skips_accelerator_phases_only(monkeypatch, capsys):
     monkeypatch.setattr(bench, "catalog_1m_latency",
                         lambda: {"catalog_1m_p50_ms": 80.0})
     monkeypatch.setattr(bench, "two_tower_bench",
-                        lambda: time.sleep(30))          # the wedge
+                        lambda: time.sleep(5))           # the wedge
     monkeypatch.setattr(bench, "seqrec_attention_bench",
                         lambda: {"seqrec": 1})           # must be SKIPPED
     monkeypatch.setattr(bench, "scale_bench", lambda: {"scale": 1})
@@ -147,7 +146,7 @@ def test_main_wedge_skips_accelerator_phases_only(monkeypatch, capsys):
 
     bench.main()
     out = capsys.readouterr().out.strip().splitlines()[-1]
-    j = json_mod.loads(out)
+    j = json.loads(out)
     cfg = j["config"]
     assert j["vs_baseline"] == 10.0
     assert "wedged" in cfg["partial"]
