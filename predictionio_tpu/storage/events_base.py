@@ -147,6 +147,22 @@ class EventBackend(abc.ABC):
     def delete(self, event_id: str, app_id: int, channel_id: int | None = None) -> bool:
         ...
 
+    def remove_before(self, app_id: int, cutoff, channel_id: int | None = None) -> int:
+        """Delete every event with ``event_time < cutoff``; returns the
+        count removed. The data-ageing verb behind
+        ``pio app data-delete --before`` (role of the reference's
+        trim-app engine, examples/experimental/scala-parallel-trim-app —
+        which re-reads and re-writes the keep-window instead). Generic
+        fallback: scan + per-event delete; backends override with a bulk
+        path."""
+        ids = [e.event_id for e in
+               self.find(EventQuery(app_id=app_id, channel_id=channel_id,
+                                    until_time=cutoff))]
+        removed = 0
+        for eid in ids:
+            removed += bool(self.delete(eid, app_id, channel_id))
+        return removed
+
     # -- queries ----------------------------------------------------------
     @abc.abstractmethod
     def find(self, query: EventQuery) -> Iterator[Event]:

@@ -230,6 +230,23 @@ class SQLiteEvents(EventBackend):
             conn.commit()
             return cur.rowcount > 0
 
+    def remove_before(self, app_id: int, cutoff, channel_id: int | None = None) -> int:
+        """Bulk time-windowed trim: one indexed DELETE instead of the
+        base class's scan + per-row deletes."""
+        table = self._ensure_table(app_id, channel_id, create=False)
+        if cutoff.tzinfo is None:
+            # naive datetimes are UTC everywhere in this codebase
+            # (EventQuery.__post_init__); .timestamp() on a naive value
+            # would read it in server-local time instead
+            cutoff = cutoff.replace(tzinfo=timezone.utc)
+        conn = self._conn()
+        with self._lock:
+            cur = conn.execute(
+                f"DELETE FROM {table} WHERE event_time < ?",
+                (cutoff.timestamp(),))
+            conn.commit()
+            return cur.rowcount
+
     # -- scans ------------------------------------------------------------
     @staticmethod
     def _where(query: EventQuery) -> tuple[str, list]:

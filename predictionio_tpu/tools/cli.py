@@ -180,17 +180,30 @@ def cmd_app(args) -> int:
         app = meta.app_get_by_name(args.name)
         if app is None:
             _die(f"App {args.name!r} not found.")
+        channel_id = None
         if args.channel:
             chans = {c.name: c for c in meta.channel_get_by_appid(app.id)}
             if args.channel not in chans:
                 _die(f"Channel {args.channel!r} not found.")
-            ch = chans[args.channel]
-            events.remove_app(app.id, ch.id)
-            events.init_app(app.id, ch.id)
+            channel_id = chans[args.channel].id
+        if args.before is not None:
+            from ..storage.event import _dt_from_wire
+            from ..storage.events_base import StorageError
+
+            try:
+                cutoff = _dt_from_wire(args.before)
+            except Exception:
+                _die(f"--before: not an ISO-8601 instant: {args.before!r}")
+            try:
+                n = events.remove_before(app.id, cutoff, channel_id)
+            except StorageError as e:
+                _die(str(e))
+            _ok(f"Trimmed {n} event(s) of app {args.name!r} before "
+                f"{cutoff.isoformat()}.")
         else:
-            events.remove_app(app.id)
-            events.init_app(app.id)
-        _ok(f"Data of app {args.name!r} deleted.")
+            events.remove_app(app.id, channel_id)
+            events.init_app(app.id, channel_id)
+            _ok(f"Data of app {args.name!r} deleted.")
     elif sub == "channel-new":
         app = meta.app_get_by_name(args.name)
         if app is None:
@@ -556,6 +569,9 @@ def build_parser() -> argparse.ArgumentParser:
     x = app_sub.add_parser("data-delete")
     x.add_argument("name")
     x.add_argument("--channel")
+    x.add_argument("--before", metavar="ISO_TIME",
+                   help="trim: delete only events with eventTime before "
+                        "this ISO-8601 instant (default: delete ALL data)")
     x = app_sub.add_parser("channel-new")
     x.add_argument("name")
     x.add_argument("channel")

@@ -231,3 +231,19 @@ def test_sqlite_batch_failure_persists_nothing(tmp_path):
     evs = list(be.find(EventQuery(APP)))
     assert len(evs) == 1
     be.close()
+
+
+def test_remove_before_trims_by_time(backend):
+    """Time-windowed trim (`pio app data-delete --before` backing verb,
+    the role of the reference's trim-app engine): events strictly older
+    than the cutoff go, the rest stay — on both backends, bulk SQL and
+    generic fallback alike."""
+    for d in range(6):
+        backend.insert(mk(eid=f"u{d}", minutes=d * 60), APP)
+    cutoff = T0 + timedelta(minutes=3 * 60)
+    assert backend.remove_before(APP, cutoff) == 3
+    left = list(backend.find(EventQuery(app_id=APP)))
+    assert len(left) == 3
+    assert all(e.event_time >= cutoff for e in left)
+    # idempotent second trim
+    assert backend.remove_before(APP, cutoff) == 0
