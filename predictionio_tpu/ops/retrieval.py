@@ -354,8 +354,15 @@ class ShardedDeviceRetriever:
         # row-pad so every shard is equal-sized and lane-aligned
         it = _pad_to(it, 128 * self._nshards, 0)
         self._shard_rows = it.shape[0] // self._nshards
-        self._items = jax.device_put(
-            jnp.asarray(it), NamedSharding(mesh, P(axis, None)))
+        # per-shard callback instead of a plain device_put: each process
+        # materializes only its ADDRESSABLE shards, so the same code
+        # serves from a mesh spanning multiple hosts (every host holds
+        # the catalog on the host side; only 1/P lands in its HBM)
+        self._items = jax.make_array_from_callback(
+            it.shape, NamedSharding(mesh, P(axis, None)),
+            lambda index: it[index])  # numpy slice: one direct
+        # host->target-device transfer per shard (jnp.asarray here would
+        # bounce every shard through the default device first)
         self._calls: dict = {}
 
     def _call_for(self, b_pad: int, k_local: int, k_out: int):

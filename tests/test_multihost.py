@@ -87,6 +87,37 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+
+def _run_workers(worker_path, args_for_pid, timeout, fail_label):
+    """Spawn one worker per pid, collect RESULT lines, kill-all on
+    timeout — the shared boilerplate of every multihost test here."""
+    env = dict(os.environ)
+    env.pop("PYTHONSTARTUP", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker_path), *args_for_pid(pid)],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=str(worker_path.parent),
+        )
+        for pid in range(2)
+    ]
+    results = {}
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail(f"{fail_label} worker timed out")
+        assert p.returncode == 0, err[-3000:]
+        for line in out.splitlines():
+            if line.startswith("RESULT "):
+                r = json.loads(line[7:])
+                results[r["pid"]] = r
+    assert set(results) == {0, 1}
+    return results
+
+
 @pytest.mark.multihost
 def test_two_process_distributed_psum_and_host_sharded_load(tmp_path):
     # seed a shared sqlite event store with 40 entities of events
@@ -111,34 +142,8 @@ def test_two_process_distributed_psum_and_host_sharded_load(tmp_path):
     worker = tmp_path / "worker.py"
     worker.write_text(WORKER_SRC % {"repo": str(REPO)})
     addr = f"127.0.0.1:{_free_port()}"
-
-    env = dict(os.environ)
-    env.pop("PYTHONSTARTUP", None)
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(worker), str(pid), "2", addr, db_path],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env=env, cwd=str(tmp_path),
-        )
-        for pid in range(2)
-    ]
-    outs = []
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=240)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("multihost worker timed out")
-        assert p.returncode == 0, err[-3000:]
-        outs.append(out)
-
-    results = {}
-    for out in outs:
-        for line in out.splitlines():
-            if line.startswith("RESULT "):
-                r = json.loads(line[7:])
-                results[r["pid"]] = r
+    results = _run_workers(worker, lambda pid: [str(pid), "2", addr, db_path],
+                           240, "multihost")
     assert set(results) == {0, 1}, outs
 
     rows = 2 * results[0]["global_devices"]
@@ -284,30 +289,8 @@ def test_two_process_als_training_parity(tmp_path):
     worker.write_text(ALS_WORKER_SRC % {
         "repo": str(REPO), "max_local": len(rows), "nu": nu, "ni": ni})
     addr = f"127.0.0.1:{_free_port()}"
-    env = dict(os.environ)
-    env.pop("PYTHONSTARTUP", None)
-    procs = [
-        subprocess.Popen(
-            [sys.executable, str(worker), str(pid), "2", addr, db_path],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env=env, cwd=str(tmp_path),
-        )
-        for pid in range(2)
-    ]
-    results = {}
-    for p in procs:
-        try:
-            out, err = p.communicate(timeout=300)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("ALS multihost worker timed out")
-        assert p.returncode == 0, err[-3000:]
-        for line in out.splitlines():
-            if line.startswith("RESULT "):
-                r = json.loads(line[7:])
-                results[r["pid"]] = r
-    assert set(results) == {0, 1}
+    results = _run_workers(worker, lambda pid: [str(pid), "2", addr, db_path],
+                           300, "ALS multihost")
 
     # both processes computed the same global model...
     u0 = np.asarray(results[0]["u"])
@@ -328,3 +311,78 @@ def test_two_process_als_training_parity(tmp_path):
     np.testing.assert_allclose(u0, ref.user_factors, rtol=2e-3, atol=2e-4)
     np.testing.assert_allclose(np.asarray(results[0]["v"]),
                                ref.item_factors, rtol=2e-3, atol=2e-4)
+
+
+SERVE_WORKER_SRC = r'''
+import json, os, sys
+
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+addr = sys.argv[3]
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, %(repo)r)
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+from predictionio_tpu.ops.retrieval import ShardedDeviceRetriever
+from predictionio_tpu.parallel.mesh import init_distributed, make_mesh
+
+init_distributed(coordinator_address=addr, num_processes=nproc, process_id=pid)
+n_global = len(jax.devices())
+assert n_global == 2 * nproc
+
+# identical catalog + queries on every host (SPMD: all processes run the
+# same serving program; each holds only its 1/P catalog shard in "HBM")
+rng = np.random.default_rng(7)
+items = rng.standard_normal((1000, 16)).astype(np.float32)
+q = rng.standard_normal((3, 16)).astype(np.float32)
+
+mesh = make_mesh((n_global,), ("model",))
+ret = ShardedDeviceRetriever(items, mesh)
+n_local = sum(s.data.shape[0] for s in ret._items.addressable_shards)
+vals, idx = ret.topk(q, 7)
+
+print("RESULT " + json.dumps({
+    "pid": pid,
+    "rows_local": int(n_local),
+    "rows_global": int(ret._items.shape[0]),
+    "vals": np.asarray(vals).tolist(),
+    "idx": np.asarray(idx).tolist(),
+}), flush=True)
+'''
+
+
+@pytest.mark.multihost
+def test_two_process_sharded_serving_parity(tmp_path):
+    """Serving-plane counterpart of the ALS multihost test: the catalog
+    shards over a mesh spanning two processes, each host materializes
+    only its addressable shards, and the sharded top-k matches exact
+    host scoring on both processes."""
+    import numpy as np
+
+    worker = tmp_path / "serve_worker.py"
+    worker.write_text(SERVE_WORKER_SRC % {"repo": str(REPO)})
+    addr = f"127.0.0.1:{_free_port()}"
+    results = _run_workers(worker, lambda pid: [str(pid), "2", addr],
+                           300, "sharded-serving multihost")
+
+    # each process holds exactly HALF the (padded) catalog locally
+    for r in results.values():
+        assert r["rows_local"] * 2 == r["rows_global"]
+
+    # both processes agree, and match exact host scoring
+    rng = np.random.default_rng(7)
+    items = rng.standard_normal((1000, 16)).astype(np.float32)
+    q = rng.standard_normal((3, 16)).astype(np.float32)
+    want = np.sort(q @ items.T, axis=1)[:, ::-1][:, :7]
+    for r in results.values():
+        np.testing.assert_allclose(np.asarray(r["vals"]), want,
+                                   rtol=1e-5, atol=1e-5)
+        idx = np.asarray(r["idx"])
+        got = np.take_along_axis(q @ items.T, idx, axis=1)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    assert results[0]["idx"] == results[1]["idx"]
