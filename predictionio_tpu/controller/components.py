@@ -22,7 +22,7 @@ as the first argument of its work methods.
 from __future__ import annotations
 
 import abc
-from typing import Any, Callable, Generic, Sequence, TypeVar
+from typing import Any, Generic, Sequence, TypeVar
 
 TD = TypeVar("TD")  # training data
 EI = TypeVar("EI")  # evaluation info

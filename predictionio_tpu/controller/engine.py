@@ -18,12 +18,11 @@ Differences by design:
 from __future__ import annotations
 
 import dataclasses
-import json
 import logging
 from typing import Any, Generic, Mapping, Sequence, TypeVar
 
 from .components import Algorithm, DataSource, Doer, Preparator, SanityCheck, Serving
-from .params import EmptyParams, EngineParams, parse_params
+from .params import EngineParams, parse_params
 
 log = logging.getLogger("predictionio_tpu.engine")
 

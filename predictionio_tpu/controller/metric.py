@@ -13,7 +13,6 @@ set ``lower_is_better=True`` to flip, the reference's custom Ordering).
 from __future__ import annotations
 
 import abc
-import math
 from typing import Any, Generic, Sequence, TypeVar
 
 import numpy as np

@@ -12,8 +12,8 @@ from __future__ import annotations
 
 import dataclasses
 import json
-from dataclasses import dataclass, field, is_dataclass
-from typing import Any, Mapping, Sequence, Type, TypeVar
+from dataclasses import dataclass, is_dataclass
+from typing import Any, Mapping, Type, TypeVar
 
 __all__ = ["Params", "EmptyParams", "EngineParams", "parse_params", "params_to_json"]
 

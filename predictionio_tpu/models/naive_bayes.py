@@ -48,7 +48,6 @@ def train_naive_bayes(
     """
     import jax
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
     if mesh is None:
         from ..parallel.mesh import make_mesh

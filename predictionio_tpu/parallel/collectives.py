@@ -16,7 +16,6 @@ at every call site.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable, Sequence
 
 __all__ = [

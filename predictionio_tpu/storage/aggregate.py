@@ -24,7 +24,7 @@ from dataclasses import dataclass, field
 from datetime import datetime
 from typing import Any, Iterable, Iterator
 
-from .datamap import DataMap, PropertyMap
+from .datamap import PropertyMap
 from .event import Event
 
 __all__ = ["EventOp", "aggregate_properties", "aggregate_properties_single"]

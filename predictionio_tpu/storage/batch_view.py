@@ -11,7 +11,7 @@ ported engine code keeps running; new code should call
 from __future__ import annotations
 
 from datetime import datetime
-from typing import Any, Callable, Iterable
+from typing import Any, Callable
 
 from ..annotation import deprecated
 from .aggregate import aggregate_properties

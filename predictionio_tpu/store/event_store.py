@@ -15,7 +15,6 @@ from __future__ import annotations
 from datetime import datetime
 from typing import Any, Iterator, Sequence
 
-import numpy as np
 
 from ..storage import EventQuery, PropertyMap, Storage
 from ..storage.event import Event

@@ -9,7 +9,7 @@ sanity-check gates, and the eval join — without any storage or devices.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from ..controller import (
     Algorithm,

@@ -19,11 +19,11 @@ from datetime import datetime, timezone
 from typing import Any, Sequence
 
 from ..controller.components import PersistentModel
-from ..controller.engine import Engine, EngineFactory, TrainResult
+from ..controller.engine import Engine, TrainResult
 from ..controller.evaluation import Evaluation, MetricEvaluator, MetricEvaluatorResult
 from ..controller.params import EngineParams, params_to_json
 from ..storage import EngineInstance, EvaluationInstance, Model, Storage
-from .context import Context, WorkflowParams
+from .context import Context
 from .serialization import (
     PersistentModelManifest,
     RetrainMarker,

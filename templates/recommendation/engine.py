@@ -12,7 +12,7 @@ Result: {"itemScores": [{"item": "i1", "score": 3.2}, ...]}
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 
