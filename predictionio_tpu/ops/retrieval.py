@@ -553,6 +553,15 @@ class RetrievalServingMixin:
         cn = row_normalize(getattr(self, self._retrieval_attr))
         self._sim_retriever = DeviceRetriever(cn, interpret=interpret)
 
+    def attach_sharded_similarity_retriever(self, mesh, *,
+                                            axis: str = "model") -> None:
+        """Sharded variant of ``attach_similarity_retriever``: the
+        normalized catalog shards over ``mesh``'s ``axis`` so cosine
+        similar-items serving scales past one chip's HBM like the
+        inner-product path does."""
+        cn = row_normalize(getattr(self, self._retrieval_attr))
+        self._sim_retriever = ShardedDeviceRetriever(cn, mesh, axis=axis)
+
     def __getstate__(self):
         state = dict(self.__dict__)
         # device arrays never enter MODELDATA

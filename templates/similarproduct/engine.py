@@ -130,6 +130,10 @@ class _CosineModel:
         queries serve from the device-resident normalized catalog."""
         self.als.attach_similarity_retriever(interpret)
 
+    def attach_sharded_retriever(self, mesh, *, axis: str = "model") -> None:
+        """Sharded deploy hook (`pio deploy --retriever-mesh N`)."""
+        self.als.attach_sharded_similarity_retriever(mesh, axis=axis)
+
     def query_rows(self, item_ids) -> list[int]:
         rows = [self.als.item_ids.get(i) for i in item_ids]
         return [r for r in rows if r is not None]

@@ -209,3 +209,28 @@ def test_deployed_preserves_sharded_attach(rng):
     d = Deployed(None, SimpleNamespace(models=[m]), retriever_mesh=mesh)
     assert isinstance(m._retriever, ShardedDeviceRetriever)
     assert d.retriever_mesh is mesh and d.retriever_axis == "model"
+
+
+def test_sharded_similarity_retriever_matches_host(rng):
+    """Cosine similar-items through the SHARDED normalized catalog must
+    match host scoring (the similarproduct family's sharded deploy)."""
+    from predictionio_tpu.models.als import ALSConfig, ALSModel
+    from predictionio_tpu.parallel.mesh import make_mesh
+    from predictionio_tpu.storage.bimap import BiMap
+
+    ni, r = 120, 8
+    m = ALSModel(
+        user_factors=rng.standard_normal((5, r)).astype(np.float32),
+        item_factors=rng.standard_normal((ni, r)).astype(np.float32),
+        user_ids=BiMap({f"u{i}": i for i in range(5)}),
+        item_ids=BiMap({f"i{i}": i for i in range(ni)}),
+        config=ALSConfig(rank=r),
+    )
+    host = m.similar_items([3, 7], 6)
+    m.attach_sharded_similarity_retriever(make_mesh((8,), ("model",)))
+    sharded = m.similar_items([3, 7], 6)
+    assert [i for i, _ in sharded] == [i for i, _ in host]
+    np.testing.assert_allclose([s for _, s in sharded],
+                               [s for _, s in host], rtol=1e-5, atol=1e-6)
+    # serialization still strips the device handle
+    assert "_sim_retriever" not in m.__getstate__()
