@@ -161,6 +161,56 @@ class ALSModel(RetrievalServingMixin):
             return []
         return self.top_n_from_catalog(self.user_factors[row], num)
 
+    def fold_in_user(self, item_ids: list, ratings=None) -> "np.ndarray | None":
+        """Exact WALS fold-in: solve one user's normal equations against
+        the trained item factors — the factor vector training WOULD have
+        produced for a user with these events, without retraining.
+
+        Serves users who appeared after training. The reference's
+        predictNewUser (examples/scala-parallel-ecommercerecommendation/
+        train-with-rate-event/src/main/scala/ALSAlgorithm.scala:285+)
+        averages the recent items' factors; this is the exact half-step
+        solve instead (same formulation as training: ALS-WR λ·max(n,1)
+        ridge in explicit mode, the Hu-Koren-Volinsky VᵀV + confidence
+        form in implicit mode). One R×R host solve — serving-cheap.
+
+        ``ratings``: per-item values aligned with ``item_ids`` (explicit
+        ratings, or implicit confidence inputs); defaults to 1.0 each.
+        Unknown item ids are skipped; returns None if none are known.
+        """
+        rows, kept = [], []
+        for j, iid in enumerate(item_ids):
+            row = self.item_ids.get(iid)
+            if row is not None:
+                rows.append(row)
+                kept.append(j)
+        if not rows:
+            return None
+        v_s = self.item_factors[rows].astype(np.float64)  # [k, R]
+        if ratings is None:
+            r = np.ones(len(rows))
+        else:
+            r = np.asarray([float(ratings[j]) for j in kept], np.float64)
+        lam = self.config.lambda_
+        rank = v_s.shape[1]
+        eye = np.eye(rank)
+        if self.config.implicit_prefs:
+            alpha = self.config.alpha
+            vtv = getattr(self, "_vtv_cache", None)
+            if vtv is None:
+                # depends only on the (immutable-after-training) factors:
+                # computed once, never per query. Stripped from MODELDATA
+                # blobs by the mixin __getstate__.
+                v_all = self.item_factors.astype(np.float64)
+                vtv = v_all.T @ v_all
+                self._vtv_cache = vtv
+            a = vtv + (v_s * (alpha * r)[:, None]).T @ v_s + lam * eye
+            b = ((1.0 + alpha * r)[:, None] * v_s).sum(axis=0)
+        else:
+            a = v_s.T @ v_s + lam * max(len(rows), 1) * eye
+            b = (r[:, None] * v_s).sum(axis=0)
+        return np.linalg.solve(a, b).astype(np.float32)
+
     def similar_items(self, item_rows: list[int], num: int,
                       candidate_mask: np.ndarray | None = None) -> list[tuple[int, float]]:
         """Cosine top-N against the whole catalog — the similarproduct

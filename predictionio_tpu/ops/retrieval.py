@@ -564,9 +564,10 @@ class RetrievalServingMixin:
 
     def __getstate__(self):
         state = dict(self.__dict__)
-        # device arrays never enter MODELDATA
+        # device arrays and derived caches never enter MODELDATA
         state.pop("_retriever", None)
         state.pop("_sim_retriever", None)
+        state.pop("_vtv_cache", None)
         return state
 
     def _retriever_topk(self, query_vec, num, inverse_ids):

@@ -160,15 +160,28 @@ class ECommAlgorithm(Algorithm):
 
     def _seen_items(self, user: str) -> set[str]:
         """(ALSAlgorithm.scala:160-181; limit mirrors its list size)"""
+        return set(self._seen_weights(user))
+
+    def _seen_weights(self, user: str) -> dict:
+        """item -> summed training-style weight (buy=2, view=1, repeats
+        accumulate) over the user's recent events — the same confidence
+        inputs training derives from these events, so fold-in matches
+        what training would have produced."""
         try:
             events = self._event_store().find(
                 entity_type="user", entity_id=user,
                 event_names=tuple(self.params.seen_events),
                 target_entity_type="item", limit=100, latest=True,
             )
-            return {e.target_entity_id for e in events if e.target_entity_id}
+            weights: dict = {}
+            for e in events:
+                if e.target_entity_id:
+                    w = 2.0 if e.event == "buy" else 1.0
+                    weights[e.target_entity_id] = \
+                        weights.get(e.target_entity_id, 0.0) + w
+            return weights
         except Exception:
-            return set()
+            return {}
 
     def _unavailable_items(self) -> set[str]:
         """Latest $set of the constraint/unavailableItems entity
@@ -266,15 +279,21 @@ class ECommAlgorithm(Algorithm):
 
     def _new_user_scores(self, model: ECommModel, query: Query,
                          seen: dict | None = None) -> np.ndarray | None:
-        """Unseen user: average the item factors of their recent views and
-        score by similarity (predictNewUser, ALSAlgorithm.scala:285+)."""
+        """Unseen user: exact WALS fold-in from their recent events —
+        the factor vector training would have produced (beyond the
+        reference's predictNewUser item-factor averaging,
+        ALSAlgorithm.scala:285+; ALSModel.fold_in_user)."""
         als = model.als
-        recent = self._seen_items_cached(query.user, seen)
-        rows = [als.item_ids[i] for i in recent if i in als.item_ids]
-        if not rows:
+        # weights, not just ids: a 5x buyer folds in with 5x the
+        # confidence of a one-time viewer, exactly as training would
+        weights = self._seen_weights(query.user)
+        if seen is not None:
+            seen.setdefault(query.user, set(weights))
+        items = sorted(weights)
+        u = als.fold_in_user(items, [weights[i] for i in items])
+        if u is None:
             return None
-        profile = als.item_factors[rows].mean(axis=0)
-        return als.item_factors @ profile
+        return als.item_factors @ u
 
 
 def engine_factory() -> Engine:

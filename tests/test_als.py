@@ -504,3 +504,74 @@ def test_similar_items_device_path_matches_host(rng, mesh8):
 
     m2 = pickle.loads(pickle.dumps(model))
     assert not hasattr(m2, "_sim_retriever")
+
+
+class TestFoldIn:
+    def _model(self, rng, implicit=False):
+        from predictionio_tpu.models.als import ALSConfig, ALSModel
+        from predictionio_tpu.storage.bimap import BiMap
+
+        ni, r = 40, 6
+        return ALSModel(
+            user_factors=rng.standard_normal((4, r)).astype(np.float32),
+            item_factors=rng.standard_normal((ni, r)).astype(np.float32),
+            user_ids=BiMap({f"u{i}": i for i in range(4)}),
+            item_ids=BiMap({f"i{i}": i for i in range(ni)}),
+            config=ALSConfig(rank=r, lambda_=0.1, alpha=2.0,
+                             implicit_prefs=implicit),
+        )
+
+    def test_explicit_matches_normal_equations(self, rng):
+        """fold_in_user must solve the SAME normal equations training
+        uses (ALS-WR λ·max(n,1) ridge), independently re-derived here."""
+        m = self._model(rng)
+        items = ["i3", "i7", "i11"]
+        r = [4.0, 2.5, 5.0]
+        u = m.fold_in_user(items, r)
+        v_s = m.item_factors[[3, 7, 11]].astype(np.float64)
+        a = v_s.T @ v_s + 0.1 * 3 * np.eye(6)
+        b = (np.asarray(r)[:, None] * v_s).sum(0)
+        np.testing.assert_allclose(u, np.linalg.solve(a, b), rtol=1e-5)
+
+    def test_implicit_matches_hkv_form(self, rng):
+        m = self._model(rng, implicit=True)
+        u = m.fold_in_user(["i0", "i5"], [1.0, 3.0])
+        v = m.item_factors.astype(np.float64)
+        v_s = v[[0, 5]]
+        conf = 2.0 * np.asarray([1.0, 3.0])
+        a = v.T @ v + (v_s * conf[:, None]).T @ v_s + 0.1 * np.eye(6)
+        b = ((1.0 + conf)[:, None] * v_s).sum(0)
+        np.testing.assert_allclose(u, np.linalg.solve(a, b), rtol=1e-5)
+
+    def test_unknown_items_skipped(self, rng):
+        m = self._model(rng)
+        assert m.fold_in_user(["nope", "nada"]) is None
+        u_mixed = m.fold_in_user(["nope", "i3"], [9.0, 4.0])
+        u_known = m.fold_in_user(["i3"], [4.0])
+        np.testing.assert_allclose(u_mixed, u_known, rtol=1e-6)
+
+    def test_fold_in_reproduces_trained_user(self, rng, mesh8):
+        """At convergence a user's trained factor IS the half-step solve
+        against the final item factors — fold_in from the user's own
+        training events must land on (approximately) the trained row."""
+        from predictionio_tpu.models.als import ALSConfig, train_als
+        from predictionio_tpu.storage.bimap import BiMap
+        from predictionio_tpu.storage.frame import Ratings
+
+        nu, ni = 12, 10
+        u_true = rng.normal(size=(nu, 3)) + 1
+        v_true = rng.normal(size=(ni, 3)) + 1
+        full = u_true @ v_true.T
+        rows, cols = np.nonzero(rng.random((nu, ni)) < 0.8)
+        vals = full[rows, cols].astype(np.float32)
+        ratings = Ratings(
+            user_indices=rows.astype(np.int64),
+            item_indices=cols.astype(np.int64), ratings=vals,
+            user_ids=BiMap({f"u{i}": i for i in range(nu)}),
+            item_ids=BiMap({f"i{j}": j for j in range(ni)}),
+        )
+        m = train_als(ratings, ALSConfig(rank=4, iterations=20, lambda_=0.05,
+                                         solver="cholesky", seed=2))
+        mask = rows == 3
+        u = m.fold_in_user([f"i{c}" for c in cols[mask]], vals[mask])
+        np.testing.assert_allclose(u, m.user_factors[3], rtol=2e-2, atol=2e-3)
