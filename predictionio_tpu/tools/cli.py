@@ -11,6 +11,7 @@ console/Console.scala:128-1245). Same verb set, no JVM/spark-submit spawning
   pio train [--engine-json engine.json] [...]
   pio eval <Evaluation> [<EngineParamsGenerator>]
   pio deploy [--port 8000] [--feedback] [--event-server-url ...]
+  pio batchpredict --input queries.jsonl --output predictions.jsonl
   pio undeploy [--port 8000]
   pio eventserver [--port 7070] [--stats]
   pio adminserver [--port 7071]
@@ -400,10 +401,10 @@ def cmd_eval(args) -> int:
     return 0
 
 
-def cmd_deploy(args) -> int:
-    _enable_compile_cache()
-    from ..workflow.create_server import run_engine_server
-
+def _resolve_engine_instance(args):
+    """Shared deploy/batchpredict preamble: engine dir checks, variant
+    load, factory import, instance lookup. Returns (engine_dir, engine,
+    instance); dies with a diagnostic when nothing deployable exists."""
     engine_dir = Path(args.engine_dir)
     _verify_template_min_version(engine_dir)
     variant = _load_variant(engine_dir, args.engine_json)
@@ -412,10 +413,22 @@ def cmd_deploy(args) -> int:
     meta = _storage().get_metadata()
     if args.engine_instance_id:
         inst = meta.engine_instance_get(args.engine_instance_id)
+        if inst is None:
+            _die(f"Engine instance {args.engine_instance_id!r} not found.")
     else:
-        inst = meta.engine_instance_get_latest_completed(engine_id, version, variant_id)
-    if inst is None:
-        _die(f"No COMPLETED training of engine {engine_id} found. Run `pio train` first.")
+        inst = meta.engine_instance_get_latest_completed(
+            engine_id, version, variant_id)
+        if inst is None:
+            _die(f"No COMPLETED training of engine {engine_id} found. "
+                 "Run `pio train` first.")
+    return engine_dir, engine, inst
+
+
+def cmd_deploy(args) -> int:
+    _enable_compile_cache()
+    from ..workflow.create_server import run_engine_server
+
+    engine_dir, engine, inst = _resolve_engine_instance(args)
     run_engine_server(
         engine,
         inst,
@@ -430,6 +443,69 @@ def cmd_deploy(args) -> int:
         retriever_mesh=_retriever_mesh(args.retriever_mesh),
     )
     return 0
+
+
+def cmd_batchpredict(args) -> int:
+    """Bulk offline inference: queries JSONL in, predictions JSONL out,
+    through the SAME rehydrated engine + batched predict path `pio
+    deploy` serves from — no HTTP in the loop. Output line shape:
+    ``{"query": {...}, "prediction": {...}}`` (or ``"error"``); queries
+    fail individually, never the whole run. (The reference line gained
+    `pio batchpredict` after 0.9.2 — this fills the same offline-scoring
+    role; Apache PredictionIO 0.13's BatchPredict.)"""
+    _enable_compile_cache()
+    from ..workflow.create_server import EngineServer
+
+    engine_dir, engine, inst = _resolve_engine_instance(args)
+    in_path, out_path = Path(args.input), Path(args.output)
+    if in_path.resolve() == out_path.resolve():
+        _die("--output must differ from --input (opening the output "
+             "truncates it)")
+    server = EngineServer(engine, inst, engine_dir=engine_dir,
+                          batch_window_ms=0,  # offline: no micro-batcher
+                          retriever_mesh=_retriever_mesh(args.retriever_mesh))
+
+    n_ok = n_err = 0
+    with open(in_path) as fin, open(out_path, "w") as fout:
+        chunk: list[tuple[int, dict]] = []
+
+        def flush():
+            nonlocal n_ok, n_err
+            if not chunk:
+                return
+            outcomes = server.serve_query_batch([q for _, q in chunk])
+            for (lineno, q), (tag, payload) in zip(chunk, outcomes):
+                if tag == "ok":
+                    fout.write(json.dumps(
+                        {"query": q, "prediction": payload}) + "\n")
+                    n_ok += 1
+                else:
+                    fout.write(json.dumps(
+                        {"query": q, "error": str(payload)}) + "\n")
+                    n_err += 1
+                    log.warning("line %d failed: %s", lineno, payload)
+            chunk.clear()
+
+        for lineno, line in enumerate(fin, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                q = json.loads(line)
+                if not isinstance(q, dict):
+                    raise ValueError("query must be a JSON object")
+            except ValueError as e:
+                fout.write(json.dumps(
+                    {"raw": line[:2000], "error": f"bad JSON: {e}"}) + "\n")
+                n_err += 1
+                continue
+            chunk.append((lineno, q))
+            if len(chunk) >= args.batch_max:
+                flush()
+        flush()
+    _ok(f"Batch predict complete: {n_ok} prediction(s), {n_err} error(s) "
+        f"-> {out_path}")
+    return 0 if n_err == 0 else 1
 
 
 def _retriever_mesh(n: int):
@@ -641,6 +717,18 @@ def build_parser() -> argparse.ArgumentParser:
                     help="shard the serving catalog over this many devices "
                          "(model axis; 0/1 = single-device catalog)")
 
+    sp = sub.add_parser("batchpredict")
+    _add_engine_args(sp)
+    sp.add_argument("--input", required=True,
+                    help="queries file, one JSON object per line")
+    sp.add_argument("--output", required=True,
+                    help="predictions file (JSONL, query + prediction/error)")
+    sp.add_argument("--engine-instance-id")
+    sp.add_argument("--batch-max", type=int, default=64,
+                    help="queries per batched predict call")
+    sp.add_argument("--retriever-mesh", type=int, default=0,
+                    help="shard the scoring catalog over this many devices")
+
     sp = sub.add_parser("undeploy")
     sp.add_argument("--ip", default="localhost")
     sp.add_argument("--port", type=int, default=8000)
@@ -688,6 +776,7 @@ COMMANDS = {
     "train": cmd_train,
     "eval": cmd_eval,
     "deploy": cmd_deploy,
+    "batchpredict": cmd_batchpredict,
     "undeploy": cmd_undeploy,
     "eventserver": cmd_eventserver,
     "adminserver": cmd_adminserver,

@@ -324,3 +324,50 @@ class RefusedEval(Evaluation):
         pio(["eval", "--fast", "--engine-dir", str(engine_dir),
              "evaluation:RefusedEval"])
     assert "fast_eval_compatible" in capsys.readouterr().err
+
+
+def test_batchpredict(engine_dir, tmp_path, rng, capsys):
+    """`pio batchpredict`: offline bulk scoring through the same engine
+    rehydration + batched predict path deploy serves from."""
+    assert pio(["app", "new", "qtest"]) == 0
+    app = Storage.get_metadata().app_get_by_name("qtest")
+    events_file = tmp_path / "events.jsonl"
+    make_events_file(events_file, rng)
+    assert pio(["import", "--appid", str(app.id), "--input",
+                str(events_file)]) == 0
+    assert pio(["train", "--engine-dir", str(engine_dir)]) == 0
+
+    queries = tmp_path / "queries.jsonl"
+    lines = [json.dumps({"user": f"u{u}", "num": 3}) for u in range(5)]
+    lines.append(json.dumps({"user": "nosuchuser", "num": 3}))  # ok: empty
+    lines.append("this is not json")                            # bad line
+    lines.append(json.dumps({"user": "u0"}))  # missing num -> default ok?
+    queries.write_text("\n".join(lines))
+    out_file = tmp_path / "preds.jsonl"
+
+    rc = pio(["batchpredict", "--engine-dir", str(engine_dir),
+              "--input", str(queries), "--output", str(out_file),
+              "--batch-max", "4"])
+    assert rc == 1  # the bad-JSON line counts as an error
+    rows = [json.loads(l) for l in out_file.read_text().splitlines()]
+    assert len(rows) == 8
+    ok_rows = [r for r in rows if "prediction" in r]
+    err_rows = [r for r in rows if "error" in r]
+    assert len(err_rows) == 1 and "bad JSON" in err_rows[0]["error"]
+    # known users got real recommendations; the unknown one an empty list
+    num3 = {r["query"]["user"]: r for r in ok_rows if r["query"].get("num") == 3}
+    for u in range(5):
+        assert len(num3[f"u{u}"]["prediction"]["itemScores"]) == 3
+    assert num3["nosuchuser"]["prediction"]["itemScores"] == []
+    # the num-less query used the Query default (10)
+    dflt = [r for r in ok_rows if "num" not in r["query"]]
+    assert len(dflt) == 1
+    assert len(dflt[0]["prediction"]["itemScores"]) == 10
+
+    # clean input -> rc 0
+    queries2 = tmp_path / "q2.jsonl"
+    queries2.write_text(json.dumps({"user": "u1", "num": 2}))
+    assert pio(["batchpredict", "--engine-dir", str(engine_dir),
+                "--input", str(queries2), "--output",
+                str(tmp_path / "p2.jsonl")]) == 0
+    capsys.readouterr()
