@@ -128,6 +128,18 @@ class SeqRecAlgorithm(Algorithm):
             itemScores=tuple(ItemScore(item=i, score=s) for i, s in recs)
         )
 
+    def batch_predict(self, model: SeqRecModel, queries) -> list:
+        """One forward pass for the whole micro-batch (the dispatcher in
+        workflow/microbatch.py feeds this; per-query predict pays one
+        device dispatch per request instead)."""
+        recs = model.batch_recommend([q.user for _, q in queries],
+                                     [q.num for _, q in queries])
+        return [
+            (i, PredictedResult(itemScores=tuple(
+                ItemScore(item=t, score=s) for t, s in rec)))
+            for (i, _q), rec in zip(queries, recs)
+        ]
+
 
 def engine_factory() -> Engine:
     return Engine(

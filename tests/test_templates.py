@@ -276,6 +276,22 @@ class TestSeqRec:
         assert out.itemScores
         assert out.itemScores[0].item == "i4"
 
+        # batched serving: one forward for the whole micro-batch, same
+        # answers as per-query predict, unknown users empty
+        queries = [(0, mod.Query(user="u0", num=2)),
+                   (1, mod.Query(user="u5", num=3)),
+                   (2, mod.Query(user="nosuch", num=2))]
+        got = dict(algo.batch_predict(model, queries))
+        assert [s.item for s in got[0].itemScores] == \
+            [s.item for s in out.itemScores]
+        single_u5 = algo.predict(model, mod.Query(user="u5", num=3))
+        assert [s.item for s in got[1].itemScores] == \
+            [s.item for s in single_u5.itemScores]
+        np.testing.assert_allclose(
+            [s.score for s in got[1].itemScores],
+            [s.score for s in single_u5.itemScores], rtol=1e-5, atol=1e-6)
+        assert got[2].itemScores == ()
+
 
 class TestRegression:
     def test_train_and_predict(self, rng, mesh8):
