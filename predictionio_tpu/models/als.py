@@ -161,6 +161,24 @@ class ALSModel(RetrievalServingMixin):
             return []
         return self.top_n_from_catalog(self.user_factors[row], num)
 
+    def _normalized_catalog(self) -> np.ndarray:
+        """Row-normalized item factors, computed once (immutable after
+        training; a masked micro-batch would otherwise re-normalize the
+        whole catalog per query). Stripped from MODELDATA blobs by the
+        mixin __getstate__."""
+        cn = getattr(self, "_cn_cache", None)
+        if cn is None:
+            from ..ops.retrieval import row_normalize
+
+            cn = row_normalize(self.item_factors)
+            self._cn_cache = cn
+        return cn
+
+    def batch_similar_items(self, queries) -> list:
+        """Batched ``similar_items`` for a whole micro-batch — see
+        ``_batch_similar_items``."""
+        return _batch_similar_items(self, queries)
+
     def fold_in_user(self, item_ids: list, ratings=None) -> "np.ndarray | None":
         """Exact WALS fold-in: solve one user's normal equations against
         the trained item factors — the factor vector training WOULD have
@@ -228,17 +246,12 @@ class ALSModel(RetrievalServingMixin):
 
         if not item_rows:
             return []
+        if getattr(self, "_sim_retriever", None) is not None \
+                and candidate_mask is None:
+            # single home of the over-fetch/skip/trim dance: batch of one
+            return _batch_similar_items(self, [(item_rows, num, None)])[0]
         qn = row_normalize(self.item_factors[item_rows])  # [k, R]
-        sim = getattr(self, "_sim_retriever", None)
-        if sim is not None and candidate_mask is None:
-            # fetch enough to survive dropping the query items themselves
-            vals, idx = sim.topk(qn.sum(0), min(num + len(item_rows),
-                                                sim.n_total))
-            skip = set(int(r) for r in item_rows)
-            out = [(int(i), float(v)) for v, i in zip(vals, idx)
-                   if i >= 0 and int(i) not in skip]
-            return out[:num]
-        cn = row_normalize(self.item_factors)
+        cn = self._normalized_catalog()
         scores = (cn @ qn.T).sum(axis=1)  # aggregate cosine over query items
         scores[item_rows] = -np.inf  # exclude the query items themselves
         if candidate_mask is not None:
@@ -247,6 +260,42 @@ class ALSModel(RetrievalServingMixin):
         top = np.argpartition(-scores, num - 1)[:num]
         top = top[np.argsort(-scores[top])]
         return [(int(i), float(scores[i])) for i in top if np.isfinite(scores[i])]
+
+
+def _batch_similar_items(model: "ALSModel", queries) -> list:
+    """Batched ``similar_items``: queries = [(item_rows, num, mask|None)].
+    Unmasked queries ride ONE fused retrieval call (aggregate cosine =
+    one [B, R] matrix of summed normalized query vectors — each query is
+    one row); masked or retriever-less queries fall back to the single
+    path. Same results as per-query ``similar_items`` (pinned by
+    test_templates batch/single parity)."""
+    from ..ops.retrieval import row_normalize
+
+    out: list = [[] for _ in queries]
+    sim = getattr(model, "_sim_retriever", None)
+    device_js = [j for j, (rows, _num, m) in enumerate(queries)
+                 if rows and m is None and sim is not None]
+    device_set = set(device_js)
+    for j, (rows, num, m) in enumerate(queries):
+        if j in device_set or not rows:
+            continue
+        out[j] = model.similar_items(rows, num, candidate_mask=m)
+    if device_js:
+        qmat = np.stack([
+            row_normalize(model.item_factors[queries[j][0]]).sum(0)
+            for j in device_js])
+        # enough to survive dropping each query's own items (a shared k
+        # only over-fetches, which cannot change any query's top-num)
+        kmax = max(min(queries[j][1] + len(queries[j][0]), sim.n_total)
+                   for j in device_js)
+        vals, idx = sim.topk(qmat, kmax)
+        for pos, j in enumerate(device_js):
+            rows, num, _m = queries[j]
+            skip = set(int(r) for r in rows)
+            res = [(int(i), float(v)) for v, i in zip(vals[pos], idx[pos])
+                   if i >= 0 and int(i) not in skip]
+            out[j] = res[:num]
+    return out
 
 
 def _run_fingerprint(ratings: Ratings, config: ALSConfig) -> int:

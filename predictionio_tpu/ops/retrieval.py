@@ -568,6 +568,7 @@ class RetrievalServingMixin:
         state.pop("_retriever", None)
         state.pop("_sim_retriever", None)
         state.pop("_vtv_cache", None)
+        state.pop("_cn_cache", None)
         return state
 
     def _retriever_topk(self, query_vec, num, inverse_ids):

@@ -188,6 +188,24 @@ class _BaseSimilarAlgorithm(Algorithm):
     def predict(self, model: _CosineModel, query: Query) -> PredictedResult:
         return PredictedResult(itemScores=model.similar(query))
 
+    def batch_predict(self, model: _CosineModel, queries) -> list:
+        """Unfiltered queries share ONE fused retrieval call (each query
+        is one row of summed normalized vectors); filtered ones keep the
+        per-query masked host path."""
+        specs = []
+        for _i, q in queries:
+            rows = model.query_rows(q.items)
+            # no known query items -> empty result; skip the O(N) mask
+            specs.append((rows, q.num,
+                          model.candidate_mask(q) if rows else None))
+        sims = model.als.batch_similar_items(specs)
+        inv = model.als.item_ids.inverse
+        return [
+            (i, PredictedResult(itemScores=tuple(
+                ItemScore(item=inv[r], score=s) for r, s in sim)))
+            for (i, _q), sim in zip(queries, sims)
+        ]
+
 
 class ALSAlgorithm(_BaseSimilarAlgorithm):
     """Implicit ALS over view events (reference ALSAlgorithm.scala:130)."""

@@ -628,3 +628,44 @@ class TestFilterByCategory:
             [s.item for s in full.itemScores]
         assert [s.item for s in got[1].itemScores] == items
         assert got[2].itemScores == ()
+
+
+class TestSimilarProductBatch:
+    def test_batch_matches_single(self, rng, mesh8):
+        """batch_predict == per-query predict, with and without the
+        device similarity retriever, filtered and unfiltered."""
+        mod = load_template("similarproduct")
+        app = setup_app()
+        TestSimilarProduct._ingest(TestSimilarProduct(), rng, app)
+        engine = mod.engine_factory()
+        ep = EngineParams(
+            data_source_params=("", mod.DataSourceParams(app_name="MyApp")),
+            algorithm_params_list=(
+                ("als", mod.AlgorithmParams(rank=4, num_iterations=8,
+                                            alpha=10.0)),),
+        )
+        result = engine.train(Context(), ep)
+        algo, model = result.algorithms[0], result.models[0]
+        queries = [
+            mod.Query(items=("i0",), num=4),
+            mod.Query(items=("i1", "i3"), num=6),
+            mod.Query(items=("i0",), num=6, categories=("odd",)),  # masked
+            mod.Query(items=("i0",), num=6, blackList=("i2",)),    # masked
+            mod.Query(items=("nope",), num=3),                     # empty
+        ]
+
+        def check():
+            batched = dict(algo.batch_predict(
+                model, list(enumerate(queries))))
+            for i, q in enumerate(queries):
+                single = algo.predict(model, q)
+                assert [s.item for s in batched[i].itemScores] == \
+                    [s.item for s in single.itemScores], (i, q)
+                np.testing.assert_allclose(
+                    [s.score for s in batched[i].itemScores],
+                    [s.score for s in single.itemScores],
+                    rtol=1e-4, atol=1e-5)
+
+        check()                                  # host path (no retriever)
+        model.attach_retriever(interpret=True)   # fused kernel path
+        check()
