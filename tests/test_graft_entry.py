@@ -2,7 +2,10 @@
 
 The driver imports the module and calls ``dryrun_multichip(8)`` directly,
 possibly after JAX has already initialized on a 1-device platform (the
-axon tunnel). Round 1 failed exactly there; these tests pin the contract.
+axon tunnel) — or on a WEDGED platform where any jax call blocks forever
+(the MULTICHIP_r04 rc-124). These tests pin the contract: the parent
+never touches jax; every phase runs in a forced-CPU subprocess with
+streamed output.
 """
 
 import os
@@ -14,12 +17,12 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def test_dryrun_multichip_in_process():
-    """Called the way the driver does, on whatever platform is live.
-
-    Under pytest the conftest already forced an 8-device CPU mesh, so this
-    exercises the in-process fast path.
-    """
+def test_dryrun_multichip_with_jax_preimported(capsys):
+    """Called the way the driver does, with jax ALREADY imported in the
+    calling process (the conftest imported it on an 8-device CPU mesh).
+    Must not probe the live backend — every phase goes through the
+    forced-CPU subprocess path — and must stream each phase's OK line."""
+    assert "jax" in sys.modules  # the scenario this test is about
     sys.path.insert(0, REPO)
     try:
         import __graft_entry__
@@ -27,12 +30,50 @@ def test_dryrun_multichip_in_process():
         __graft_entry__.dryrun_multichip(8)
     finally:
         sys.path.remove(REPO)
+    out = capsys.readouterr().out
+    # one OK line per phase + the final summary line
+    assert out.count("dryrun_multichip OK") == len(
+        sys.modules["__graft_entry__"].DRYRUN_PHASES) + 1
+    assert "all 4 phases passed" in out
+
+
+def test_dryrun_multichip_never_initializes_backend_in_parent():
+    """The r4 regression pin: the parent process must complete the dryrun
+    WITHOUT initializing any jax backend — on a wedged platform even
+    ``len(jax.devices())`` blocks forever inside a C frame, so the only
+    safe parent is one that never touches the backend. Two pins: the
+    parent runs under a nonexistent JAX_PLATFORMS (any accidental init
+    raises), and xla_bridge's backend registry must stay empty after the
+    run (the sitecustomize pre-imports jax into every process, so
+    'jax' in sys.modules alone proves nothing)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "no-such-platform"  # children override to cpu
+    code = (
+        "import sys; sys.path.insert(0, %r)\n"
+        "import __graft_entry__\n"
+        "__graft_entry__.dryrun_multichip(2)\n"
+        "if 'jax' in sys.modules:\n"
+        "    from jax._src import xla_bridge\n"
+        "    assert not xla_bridge._backends, 'parent initialized a backend'\n"
+        "print('PARENT-CLEAN')\n" % REPO
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=600,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    assert "PARENT-CLEAN" in proc.stdout
+    assert "all 4 phases passed" in proc.stdout
 
 
 def test_dryrun_multichip_from_one_device_platform():
-    """The exact round-1 failure: JAX already initialized with ONE device
-    when dryrun_multichip(8) is called. Must re-exec into a forced
-    8-device CPU subprocess and succeed."""
+    """The round-1 failure: JAX already initialized with ONE device when
+    dryrun_multichip(8) is called. Must run forced 8-device CPU
+    subprocesses and succeed."""
     env = dict(os.environ)
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
     env["JAX_PLATFORMS"] = "cpu"
@@ -53,11 +94,54 @@ def test_dryrun_multichip_from_one_device_platform():
         env=env,
         capture_output=True,
         text=True,
-        timeout=600,
+        timeout=900,
         cwd=REPO,
     )
     assert proc.returncode == 0, proc.stderr[-3000:]
-    assert "dryrun_multichip OK" in proc.stdout
+    assert "all 4 phases passed" in proc.stdout
+
+
+def test_dryrun_failed_phase_continues_and_aggregates():
+    """A crashed phase must not eat the run: the parent reports the FAIL,
+    runs the REMAINING phases, and raises an aggregate error at the end —
+    the streamed OK lines of finished phases survive."""
+    sys.path.insert(0, REPO)
+    try:
+        import __graft_entry__
+
+        orig = __graft_entry__.DRYRUN_PHASES
+        # 'boom' is not a registered phase: the child exits rc!=0 fast,
+        # standing in for a crashed phase; 'serving' after it proves the
+        # loop continues past a failure
+        __graft_entry__.DRYRUN_PHASES = ("boom", "serving")
+        try:
+            with pytest.raises(RuntimeError, match="boom"):
+                __graft_entry__.dryrun_multichip(2)
+        finally:
+            __graft_entry__.DRYRUN_PHASES = orig
+    finally:
+        sys.path.remove(REPO)
+
+
+def test_dryrun_phase_timeout_kills_child(monkeypatch, capsys):
+    """The real timeout branch: a child that HANGS (the _test_hang hook
+    sleeps without touching jax) must be killed at the per-phase budget
+    and reported in the aggregate error."""
+    monkeypatch.setenv("PIO_DRYRUN_PHASE_TIMEOUT_S", "4")
+    sys.path.insert(0, REPO)
+    try:
+        import __graft_entry__
+
+        orig = __graft_entry__.DRYRUN_PHASES
+        __graft_entry__.DRYRUN_PHASES = ("_test_hang",)
+        try:
+            with pytest.raises(RuntimeError, match="timed out after 4s"):
+                __graft_entry__.dryrun_multichip(2)
+        finally:
+            __graft_entry__.DRYRUN_PHASES = orig
+    finally:
+        sys.path.remove(REPO)
+    assert "timed out" in capsys.readouterr().out
 
 
 def test_entry_compiles():
