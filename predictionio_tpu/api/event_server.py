@@ -61,8 +61,13 @@ def _json_error(status: int, message: str) -> web.Response:
     return web.json_response({"message": message}, status=status)
 
 
-async def _authenticate(request: web.Request) -> AuthData | web.Response:
-    """Query-param access-key auth (EventAPI.scala:88-116)."""
+async def _authenticate(request: web.Request,
+                        ingest: bool = False) -> AuthData | web.Response:
+    """Query-param access-key auth (EventAPI.scala:88-116). ``ingest``:
+    the caller is a write path, so a bookable auth failure (invalid
+    channel on a known app) counts toward /stats.json — read paths must
+    not book, or polling a bad channel would masquerade as rejected
+    ingest traffic."""
     access_key = request.query.get("accessKey")
     if not access_key:
         return _json_error(401, "Missing accessKey.")
@@ -77,6 +82,9 @@ async def _authenticate(request: web.Request) -> AuthData | web.Response:
     for ch in channels:
         if ch.name == channel:
             return AuthData(app_id=ak.appid, channel_id=ch.id, events=tuple(ak.events))
+    if ingest:
+        # the one auth failure with a known app: bookable per-app
+        _bump_stats(request, ak.appid, 401)
     return _json_error(401, f"Invalid channel '{channel}'.")
 
 
@@ -85,30 +93,44 @@ def _parse_time(s: str | None) -> datetime | None:
 
 
 def _validate_api_event(auth: AuthData, data: dict):
-    """API-JSON dict -> Event, or an error (status, body) pair — the ONE
-    home of API-path validation for the single and batch endpoints.
-    Never trusts a client-supplied eventId: ids are assigned server-side
-    (the reference's APISerializer doesn't read eventId either); the
-    bulk-import tool is the only id-preserving path."""
+    """API-JSON dict -> Event, or an error (status, body, event|None)
+    triple — the ONE home of API-path validation for the single and batch
+    endpoints. The triple carries the parsed Event when one exists (the
+    403 key-scope reject) so the reject can be booked under its real
+    (entityType, event) key. Never trusts a client-supplied eventId: ids
+    are assigned server-side (the reference's APISerializer doesn't read
+    eventId either); the bulk-import tool is the only id-preserving
+    path."""
     if not isinstance(data, dict):
-        return 400, {"message": "Event must be a JSON object."}
+        return 400, {"message": "Event must be a JSON object."}, None
     try:
         event = event_from_api_dict(
             {k: v for k, v in data.items() if k != "eventId"})
     except ValidationError as e:
-        return 400, {"message": str(e)}
+        return 400, {"message": str(e)}, None
     if auth.events and event.event not in auth.events:
         return 403, {
             "message": f"event {event.event!r} is not allowed by this access key"
-        }
+        }, event
     return event
 
 
-def _bump_stats(request: web.Request, auth: AuthData, event) -> None:
+def _bump_stats(request: web.Request, app_id: int, status: int,
+                event=None) -> None:
+    """Book one ingest outcome with its ACTUAL status — 201s, 400
+    validation rejects, 403 key-scope rejects, 500 storage errors — the
+    way the reference books ``result.status`` per request
+    (EventAPI.scala:195-199 -> StatsActor.scala:28-70); that is what
+    makes /stats.json useful for spotting rejected events. Requests
+    failing auth before an app is known cannot be booked per-app."""
     stats: Stats | None = request.app.get(STATS_KEY)
-    if stats is not None:
+    if stats is None:
+        return
+    if event is None:
+        stats.update(app_id, status)
+    else:
         stats.update(
-            auth.app_id, 201,
+            app_id, status,
             entity_type=event.entity_type,
             target_entity_type=event.target_entity_type,
             event=event.event,
@@ -129,8 +151,9 @@ async def _insert_one(
             events.insert, event, auth.app_id, auth.channel_id
         )
     except StorageError as e:
+        _bump_stats(request, auth.app_id, 500, event)
         return 500, {"message": str(e)}
-    _bump_stats(request, auth, event)
+    _bump_stats(request, auth.app_id, 201, event)
     return 201, {"eventId": event_id}
 
 
@@ -138,10 +161,12 @@ async def _insert_event_dict(
     request: web.Request, auth: AuthData, data: dict
 ) -> tuple[int, dict]:
     """Validate + insert one API-JSON event; returns (status, body)."""
-    event = _validate_api_event(auth, data)
-    if isinstance(event, tuple):
-        return event
-    return await _insert_one(request, auth, event)
+    validated = _validate_api_event(auth, data)
+    if isinstance(validated, tuple):
+        status, body, event = validated
+        _bump_stats(request, auth.app_id, status, event)
+        return status, body
+    return await _insert_one(request, auth, validated)
 
 
 # -- handlers ---------------------------------------------------------------
@@ -151,12 +176,13 @@ async def handle_root(request: web.Request) -> web.Response:
 
 
 async def handle_post_event(request: web.Request) -> web.Response:
-    auth = await _authenticate(request)
+    auth = await _authenticate(request, ingest=True)
     if isinstance(auth, web.Response):
         return auth
     try:
         data = await request.json()
     except (json.JSONDecodeError, UnicodeDecodeError):
+        _bump_stats(request, auth.app_id, 400)
         return _json_error(400, "Malformed JSON body.")
     status, body = await _insert_event_dict(request, auth, data)
     return web.json_response(body, status=status)
@@ -166,16 +192,23 @@ async def handle_post_batch(request: web.Request) -> web.Response:
     """Batch ingestion: a JSON array of events; per-event status in order.
     (The reference gained /batch/events.json right after 0.9.2; the import
     tool also needs it.) Max 50 per request, like the official SDKs."""
-    auth = await _authenticate(request)
+    auth = await _authenticate(request, ingest=True)
     if isinstance(auth, web.Response):
         return auth
     try:
         data = await request.json()
     except (json.JSONDecodeError, UnicodeDecodeError):
+        _bump_stats(request, auth.app_id, 400)
         return _json_error(400, "Malformed JSON body.")
     if not isinstance(data, list):
+        _bump_stats(request, auth.app_id, 400)
         return _json_error(400, "Batch body must be a JSON array of events.")
     if len(data) > 50:
+        # one row PER rejected event, matching the accepted path's
+        # per-event rows — else a size-capped batch books 1 against the
+        # accepted batch's 50 and rejected volume reads ~2% of reality
+        for _ in data:
+            _bump_stats(request, auth.app_id, 400)
         return _json_error(400, "Batch size exceeds the limit of 50 events.")
     # validate everything first, then ONE backend insert_batch for the
     # valid events (sqlite overrides it with a single executemany
@@ -185,13 +218,14 @@ async def handle_post_batch(request: web.Request) -> web.Response:
     results: list[dict | None] = []
     valid: list[tuple[int, object]] = []  # (result slot, Event)
     for item in data:
-        event = _validate_api_event(auth, item)
-        if isinstance(event, tuple):
-            status, body = event
+        validated = _validate_api_event(auth, item)
+        if isinstance(validated, tuple):
+            status, body, ev = validated
+            _bump_stats(request, auth.app_id, status, ev)
             results.append({"status": status, **body})
             continue
         results.append(None)  # filled from the batch insert below
-        valid.append((len(results) - 1, event))
+        valid.append((len(results) - 1, validated))
     if valid:
         events_dao = Storage.get_events()
         # only atomic backends take the one-call fast path: a non-atomic
@@ -206,8 +240,9 @@ async def handle_post_batch(request: web.Request) -> web.Response:
                     auth.app_id, auth.channel_id)
             except StorageError as e:
                 # atomic contract: nothing persisted — 500 for all is exact
-                for slot, _event in valid:
+                for slot, event in valid:
                     results[slot] = {"status": 500, "message": str(e)}
+                    _bump_stats(request, auth.app_id, 500, event)
             else:
                 if len(ids) != len(valid):
                     # contract violation AFTER a successful insert: events
@@ -215,16 +250,17 @@ async def handle_post_batch(request: web.Request) -> web.Response:
                     # distinct from the nothing-persisted 500 above
                     log.error("insert_batch returned %d ids for %d events",
                               len(ids), len(valid))
-                    for slot, _event in valid:
+                    for slot, event in valid:
                         results[slot] = {
                             "status": 500,
                             "message": "backend returned inconsistent ids; "
                                        "events may already be persisted — "
                                        "do not blindly retry"}
+                        _bump_stats(request, auth.app_id, 500, event)
                 else:
                     for (slot, event), event_id in zip(valid, ids):
                         results[slot] = {"status": 201, "eventId": event_id}
-                        _bump_stats(request, auth, event)
+                        _bump_stats(request, auth.app_id, 201, event)
         else:
             for slot, event in valid:
                 status, body = await _insert_one(request, auth, event)
@@ -318,7 +354,7 @@ async def handle_stats(request: web.Request) -> web.Response:
 
 async def handle_webhook_post(request: web.Request) -> web.Response:
     """JSON (.json suffix) and form connectors (Webhooks.scala:36-120)."""
-    auth = await _authenticate(request)
+    auth = await _authenticate(request, ingest=True)
     if isinstance(auth, web.Response):
         return auth
     name = request.match_info["name"]
@@ -331,14 +367,17 @@ async def handle_webhook_post(request: web.Request) -> web.Response:
         if is_json:
             payload = await request.json()
             if not isinstance(payload, dict):
+                _bump_stats(request, auth.app_id, 400)
                 return _json_error(400, "Webhook body must be a JSON object.")
         else:
             form = await request.post()
             payload = {k: form[k] for k in form}
         event_json = connector.to_event_json(payload)
     except ConnectorException as e:
+        _bump_stats(request, auth.app_id, 400)
         return _json_error(400, str(e))
     except (json.JSONDecodeError, UnicodeDecodeError):
+        _bump_stats(request, auth.app_id, 400)
         return _json_error(400, "Malformed body.")
     status, body = await _insert_event_dict(request, auth, event_json)
     return web.json_response(body, status=status)

@@ -53,9 +53,15 @@ class Stats:
         # bucket-hour -> Counter[(app_id, status, ETE)]
         self._buckets: dict[datetime, Counter] = {}
 
-    def update(self, app_id: int, status: int, *, entity_type: str,
-               target_entity_type: str | None, event: str,
+    def update(self, app_id: int, status: int, *, entity_type: str = "",
+               target_entity_type: str | None = None, event: str = "",
                now: datetime | None = None) -> None:
+        """Book one request outcome. Omit the ETE fields for requests
+        whose event never parsed (malformed body, batch-shape errors):
+        those book into ``statusCount`` only — the reference's
+        bookkeeping keys by status the same way (StatsActor.scala:28-70),
+        and status-only rows are what makes /stats.json show rejected
+        traffic next to accepted events."""
         now = now or datetime.now(timezone.utc)
         ete = EntityTypesEvent(entity_type, target_entity_type, event)
         bucket = _hour_bucket(now)
@@ -84,7 +90,8 @@ class Stats:
                     if aid != app_id:
                         continue
                     status_count[status] += n
-                    ete_count[ete] += n
+                    if ete.entity_type or ete.event:
+                        ete_count[ete] += n
         return {
             "startTime": start.isoformat() if start else None,
             "statusCount": {str(k): v for k, v in sorted(status_count.items())},

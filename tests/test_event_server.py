@@ -294,3 +294,78 @@ def test_batch_atomicity_contract(server, app_key):
                            side_effect=AssertionError("fast path taken")):
         r = requests.post(url, json=batch)
     assert [x["status"] for x in r.json()] == [201, 201, 201]
+
+
+def test_stats_books_every_request_status(server, app_key):
+    """/stats.json books the ACTUAL status of every ingest outcome — 201
+    accepts, 400 malformed/invalid, 401 bad channel, 403 key-scope
+    rejects, 500 storage errors — like the reference's per-request
+    bookkeeping (EventAPI.scala:195-199 -> StatsActor.scala:28-70), so
+    rejected traffic is visible next to accepted events."""
+    import unittest.mock as mock
+
+    app, key = app_key
+    url = f"{server.url}/events.json?accessKey={key}"
+
+    assert requests.post(url, json=EV).status_code == 201
+    # 400: malformed JSON body (no parseable event -> status-only row)
+    r = requests.post(url, data="{nope",
+                      headers={"Content-Type": "application/json"})
+    assert r.status_code == 400
+    # 400: fails event validation (still no Event to attribute)
+    assert requests.post(url, json={"entityType": "user"}).status_code == 400
+    # 401: valid key, invalid channel — the one bookable auth failure
+    assert requests.post(url + "&channel=nope", json=EV).status_code == 401
+    # 403: key-scope reject — booked under the event's real ETE
+    meta = Storage.get_metadata()
+    restricted = meta.access_key_insert(app.id, events=("view",))
+    assert requests.post(
+        f"{server.url}/events.json?accessKey={restricted.key}", json=EV
+    ).status_code == 403
+    # 500: storage failure on insert
+    events_dao = Storage.get_events()
+    with mock.patch.object(type(events_dao), "insert",
+                           side_effect=StorageError("disk full")):
+        assert requests.post(url, json=EV).status_code == 500
+
+    body = requests.get(f"{server.url}/stats.json?accessKey={key}").json()
+    assert body["statusCount"] == {
+        "201": 1, "400": 2, "401": 1, "403": 1, "500": 1}
+    # the 201/403/500 all carried the same (user, item, rate) event key;
+    # the unparseable 400s and the 401 book status-only — no blank ETE rows
+    assert body["eteCount"] == [{
+        "entityType": "user", "targetEntityType": "item",
+        "event": "rate", "count": 3}]
+
+
+def test_stats_books_batch_per_event_statuses(server, app_key):
+    """Batch ingest books each event's own outcome, not the wrapper 200;
+    a size-capped batch books one 400 PER event so rejected volume stays
+    comparable to accepted volume."""
+    _, key = app_key
+    url = f"{server.url}/batch/events.json?accessKey={key}"
+    batch = [EV, {"bad": 1}, dict(EV, entityId="u9")]
+    r = requests.post(url, json=batch)
+    assert r.status_code == 200
+    assert [x["status"] for x in r.json()] == [201, 400, 201]
+    body = requests.get(f"{server.url}/stats.json?accessKey={key}").json()
+    assert body["statusCount"] == {"201": 2, "400": 1}
+
+    oversize = [dict(EV, entityId=f"o{i}") for i in range(51)]
+    assert requests.post(url, json=oversize).status_code == 400
+    body = requests.get(f"{server.url}/stats.json?accessKey={key}").json()
+    assert body["statusCount"] == {"201": 2, "400": 52}
+
+
+def test_stats_read_paths_do_not_book(server, app_key):
+    """Auth failures and hits on READ endpoints must not book: a
+    dashboard polling a bad channel would otherwise masquerade as
+    rejected ingest traffic in /stats.json."""
+    _, key = app_key
+    # read path with invalid channel: 401 but NOT booked
+    r = requests.get(f"{server.url}/events.json?accessKey={key}&channel=no")
+    assert r.status_code == 401
+    # successful read paths: not booked either
+    requests.get(f"{server.url}/events.json?accessKey={key}")
+    body = requests.get(f"{server.url}/stats.json?accessKey={key}").json()
+    assert body["statusCount"] == {}
