@@ -75,10 +75,13 @@ DEFAULT_CG_ITERS = 8
 #: warm-started explicit solves (the training sweep seeds each inner
 #: solve with the row's previous factors, leaving CG only the sweep's
 #: delta) converge in fewer iterations: measured on the bench accuracy
-#: gate, warm depth 5 lands closer to the exact solver than cold depth
-#: 8 — a ~1/3 cut of the solve phase's gramian re-read traffic. Cold
-#: solves (no x0) keep DEFAULT_CG_ITERS.
-DEFAULT_CG_ITERS_WARM = 5
+#: gate, warm depth 4 lands at noise distance from the exact solver
+#: (gap 2.5e-06..4.3e-05 across seed pairs at ML-20M shape, vs 3.5e-05
+#: at depth 5) and cuts half the solve phase's gramian re-read traffic
+#: vs cold depth 8 (~2% on the full step vs depth 5). Depth 3 passes
+#: the 1e-3 gate with only ~2x margin (4.8e-04) — too thin to ship.
+#: Cold solves (no x0) keep DEFAULT_CG_ITERS.
+DEFAULT_CG_ITERS_WARM = 4
 DEFAULT_CG_ITERS_IMPLICIT = 16
 
 
@@ -358,10 +361,11 @@ def _spd_solve(a, b, *, solver="cg", cg_iters=DEFAULT_CG_ITERS,
     factors move less and less between sweeps, so seeding each inner
     solve with the row's previous factors leaves CG only the sweep's
     *delta* to resolve — measured on the bench gate, warm-started depth
-    5 (DEFAULT_CG_ITERS_WARM, what the training sweep resolves to) lands
-    closer to the exact solver than cold depth 8, cutting the solve
-    phase's dominant gramian re-read traffic ~1/3 net of the one extra
-    matvec the seed costs (initial residual r0 = b - A·x0).
+    4 (DEFAULT_CG_ITERS_WARM, what the training sweep resolves to) lands
+    at noise distance from the exact solver, cutting the solve phase's
+    dominant gramian re-read traffic roughly in half vs cold depth 8 net
+    of the one extra matvec the seed costs (initial residual
+    r0 = b - A·x0). Depth ladder: see the DEFAULT_CG_ITERS_WARM comment.
 
     The CG path is JACOBI-PRECONDITIONED: z = r / diag(A). The ridge-set
     gramians' diagonals span the degree skew (λ·n_u ranges over 4 decades
