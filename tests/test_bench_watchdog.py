@@ -96,6 +96,27 @@ def test_run_joined_abandons_wedged_phase():
     assert status == "error" and res is boom
 
 
+def test_run_tagged_child_rejects_partial_rows_on_crash():
+    """A bench child that prints some tagged rows and THEN crashes must
+    not read as success — partial rows with rc != 0 raise, with the
+    child's tails in the message for diagnosis."""
+    import pytest
+
+    sys.path.insert(0, str(REPO))
+    import bench
+
+    code = "print('TAG a 1'); import sys; sys.stderr.write('boom\\n'); sys.exit(3)"
+    with pytest.raises(RuntimeError) as ei:
+        bench._run_tagged_child(code, "TAG", timeout=60)
+    assert "rc=3" in str(ei.value) and "boom" in str(ei.value)
+
+    # the success path returns the split fields, tag stripped
+    rows = bench._run_tagged_child(
+        "print('TAG x 1.5'); print('untagged'); print('TAG y 2.5')",
+        "TAG", timeout=60)
+    assert rows == [["x", "1.5"], ["y", "2.5"]]
+
+
 def test_external_kill_mid_run_leaves_parsable_artifact():
     """The r4 evidence failure: the driver killed bench.py externally and
     `BENCH_r04.json` recorded `parsed: null`. main() now prints the
