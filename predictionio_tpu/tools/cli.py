@@ -788,6 +788,23 @@ COMMANDS = {
 }
 
 
+def _apply_platform_override() -> None:
+    """``PIO_PLATFORM=cpu`` (or ``tpu``) pins the jax backend before any
+    verb touches the device — the reference's local-mode escape hatch
+    (small/CI runs on the host; an unreachable accelerator would
+    otherwise hang `pio train` inside backend init, which no try/except
+    can interrupt). Both the env var and the config are set: some
+    environments re-point ``JAX_PLATFORMS`` at interpreter startup
+    (sitecustomize), so the env alone is not authoritative."""
+    plat = os.environ.get("PIO_PLATFORM")
+    if not plat:
+        return
+    os.environ["JAX_PLATFORMS"] = plat
+    import jax
+
+    jax.config.update("jax_platforms", plat)
+
+
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     logging.basicConfig(
@@ -797,6 +814,7 @@ def main(argv: list[str] | None = None) -> int:
     if args.command == "version":
         print(__version__)
         return 0
+    _apply_platform_override()
     return COMMANDS[args.command](args)
 
 

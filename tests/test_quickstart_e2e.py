@@ -5,6 +5,7 @@ The automated version of the reference's manual quickstart scripts
 L1-L8 slice the reference never tests automatically."""
 
 import json
+import os
 import shutil
 import sys
 from pathlib import Path
@@ -371,3 +372,28 @@ def test_batchpredict(engine_dir, tmp_path, rng, capsys):
                 "--input", str(queries2), "--output",
                 str(tmp_path / "p2.jsonl")]) == 0
     capsys.readouterr()
+
+
+def test_pio_platform_override(monkeypatch):
+    """PIO_PLATFORM pins both the env var and the jax config (some
+    environments re-point JAX_PLATFORMS at interpreter startup, so the
+    env alone is not authoritative) — the local-mode escape hatch that
+    keeps `pio train` off an unreachable accelerator. Round-5 live-fire:
+    the full bin/pio quickstart completed on a wedged platform with
+    PIO_PLATFORM=cpu where the unpinned run hung in backend init."""
+    import jax
+
+    from predictionio_tpu.tools import cli
+
+    monkeypatch.delenv("PIO_PLATFORM", raising=False)
+    monkeypatch.setenv("JAX_PLATFORMS", "sentinel")
+    cli._apply_platform_override()  # unset -> no-op
+    assert os.environ["JAX_PLATFORMS"] == "sentinel"
+
+    # distinguishable pre-state: conftest already pins the config to
+    # "cpu", which would make asserting "cpu" after the override vacuous
+    jax.config.update("jax_platforms", "")
+    monkeypatch.setenv("PIO_PLATFORM", "cpu")
+    cli._apply_platform_override()
+    assert os.environ["JAX_PLATFORMS"] == "cpu"
+    assert jax.config.jax_platforms == "cpu"  # the override set it back
