@@ -856,9 +856,7 @@ def sharded_retrieval_bench() -> dict:
     tests/test_retrieval.py and the multichip dryrun). The 1-way point
     is the unsharded baseline of the same XLA program, so the delta
     isolates exactly the sharding overhead (shard_map + collective
-    merge); the single-device DeviceRetriever is NOT the baseline here
-    because on CPU it runs the Pallas kernel in interpret mode, which
-    is no latency statement."""
+    merge) with no other code-path difference."""
     code = _VMESH_PREAMBLE + r"""
 from predictionio_tpu.ops.retrieval import ShardedDeviceRetriever
 from predictionio_tpu.parallel.mesh import make_mesh
@@ -1267,8 +1265,8 @@ def main() -> None:
     ]
     if platform == "tpu":
         # serving latency and the e2e child need the real accelerator
-        # (interpret-mode retrieval kernels are no latency statement, and
-        # the quickstart subprocess would hang on a wedged platform)
+        # (host-backend retrieval latency is no TPU serving statement,
+        # and the quickstart subprocess would hang on a wedged platform)
         sections = [
             ("predict latency",
              lambda: predict_latency(result["u"], result["v"]), 900, True),

@@ -13,8 +13,11 @@ one [B, D] x [D, T] MXU matmul and merges the tile's scores into a running
 [B, k] accumulator held in the (revisited) output block, so the full score
 matrix never exists. k merge rounds per tile are VPU work over [B, k+T].
 
-CPU/test path: the same kernel under ``interpret=True`` (numerically
-identical); auto-selected off-TPU.
+Off-TPU, serving auto-selects a plain-XLA top-k over the same padded
+catalog (`_run_topk_xla` — fast compiled host code with the identical
+output contract); ``interpret=True`` forces the kernel under the Pallas
+interpreter (numerically identical, ~65x slower on CPU), the parity
+path the kernel tests pin TPU semantics with.
 """
 
 from __future__ import annotations
@@ -157,10 +160,19 @@ def _build_call(B, D, N_pad, n_total, k, tile_n, interpret):
     two sequential pulls would double the serving latency the kernel's
     ~1ms of device time cannot explain. Indices are exact in f32 below
     2^24; a larger catalog falls back to the two-buffer path."""
+    return _jit_with_packing(
+        _raw_call(B, D, N_pad, n_total, k, tile_n, interpret), n_total)
+
+
+def _jit_with_packing(call, n_total: int):
+    """The ONE home of the pack/no-pack policy for every single-device
+    top-k builder (kernel and XLA): below PACKED_IDX_LIMIT, values and
+    indices leave the device as one [B, 2k] f32 buffer (one host pull =
+    one dispatch round trip); at/above it, the two-buffer path keeps
+    indices exact. Returns (jitted callable, is_packed)."""
     import jax
     import jax.numpy as jnp
 
-    call = _raw_call(B, D, N_pad, n_total, k, tile_n, interpret)
     if n_total >= PACKED_IDX_LIMIT:
         return jax.jit(call), False
 
@@ -169,6 +181,53 @@ def _build_call(B, D, N_pad, n_total, k, tile_n, interpret):
         return jnp.concatenate([vals, idx.astype(jnp.float32)], axis=1)
 
     return jax.jit(packed), True
+
+
+def _raw_xla_call(n_total: int, k: int):
+    """Un-jitted plain-XLA top-k over the full padded catalog — the
+    serving path for NON-TPU backends, where running the Pallas kernel
+    under ``interpret=True`` is a correctness tool, not a serving path
+    (measured ~1.3 s/query on the CPU backend vs ~20 ms here at a 64k
+    catalog). Same output contract as the kernel: padded/overflow slots
+    carry value -inf and index -1."""
+    import jax
+    import jax.numpy as jnp
+
+    def run(q, items):  # q [B, D_pad] f32, items [N_pad, D_pad] f32
+        scores = jax.lax.dot_general(
+            q, items, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,  # rank-stable vs the
+            # kernel / sharded paths and host f32 references (DEFAULT
+            # would allow TF32-class matmuls on some non-TPU backends)
+        )
+        col = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+        scores = jnp.where(col < n_total, scores, -jnp.inf)
+        vals, idx = jax.lax.top_k(scores, k)
+        idx = jnp.where(jnp.isfinite(vals), idx, -1).astype(jnp.int32)
+        return vals, idx
+
+    return run
+
+
+@functools.partial(functools.lru_cache(maxsize=32))
+def _build_xla_call(n_total, k):
+    """Jitted XLA top-k behind the shared packing policy. Keyed on
+    (n_total, k) only: jit itself retraces per input shape under the
+    one returned callable, so adding shape keys would just fragment
+    the 32-entry bound."""
+    return _jit_with_packing(_raw_xla_call(n_total, k), n_total)
+
+
+def _run_topk_xla(q: np.ndarray, items_dev, n_total: int, k: int):
+    """Single-device entry, plain-XLA path (non-TPU serving)."""
+    import jax.numpy as jnp
+
+    def invoke(qp, k_pad):
+        call, is_packed = _build_xla_call(n_total, k_pad)
+        return call(jnp.asarray(qp), items_dev), is_packed
+
+    return _dispatch_topk(q, n_total, k, invoke)
 
 
 def topk_device_seconds(retriever: "DeviceRetriever", k: int,
@@ -188,8 +247,12 @@ def topk_device_seconds(retriever: "DeviceRetriever", k: int,
     d = retriever._items.shape[1]
     b_pad, k_pad = _query_shapes(1, min(k, retriever.n_total),
                                  retriever.n_total)
-    call = _raw_call(b_pad, d, retriever._items.shape[0], retriever.n_total,
-                     k_pad, retriever._tile_n, retriever._interpret)
+    if retriever._mode == "xla":
+        call = _raw_xla_call(retriever.n_total, k_pad)
+    else:
+        call = _raw_call(b_pad, d, retriever._items.shape[0],
+                         retriever.n_total, k_pad, retriever._tile_n,
+                         retriever._mode == "interpret")
     qs = jnp.asarray(
         np.random.default_rng(0).normal(size=(iters, b_pad, d)),
         jnp.float32)
@@ -276,23 +339,38 @@ def _run_topk(q: np.ndarray, items_dev, n_total: int, k: int, tile_n: int,
     return _dispatch_topk(q, n_total, k, invoke)
 
 
+def _resolve_topk_mode(interpret) -> str:
+    """``interpret=None`` picks the serving path for the backend: the
+    native Pallas kernel on TPU, plain XLA elsewhere (fast compiled
+    host code). ``interpret=True`` forces the Pallas kernel under the
+    interpreter — the TPU-semantics parity path tests use, ~65x slower
+    than the XLA path on CPU, never a serving default. ``False`` forces
+    the native kernel."""
+    if interpret is None:
+        import jax
+
+        return "native" if jax.default_backend() == "tpu" else "xla"
+    return "interpret" if interpret else "native"
+
+
 def topk_scores(queries, items, k: int, *, tile_n: int = 512, interpret=None):
     """Top-k inner-product retrieval: (values [B, k], indices [B, k]).
 
     queries: [B, D] or [D]; items: [N, D]. Indices of padded/overflow slots
-    are -1. Runs the Pallas kernel natively on TPU, in interpreter mode
-    elsewhere.
+    are -1. Runs the Pallas kernel natively on TPU, plain XLA elsewhere;
+    ``interpret=True`` forces the interpret-mode kernel (parity testing).
     """
-    import jax
     import jax.numpy as jnp
 
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+    mode = _resolve_topk_mode(interpret)
     q = np.asarray(queries, dtype=np.float32)
     it = np.asarray(items, dtype=np.float32)
     n_total = it.shape[0]
     it, tile_n = _pad_items(it, n_total, tile_n)
-    return _run_topk(q, jnp.asarray(it), n_total, k, tile_n, bool(interpret))
+    items_dev = jnp.asarray(it)
+    if mode == "xla":
+        return _run_topk_xla(q, items_dev, n_total, k)
+    return _run_topk(q, items_dev, n_total, k, tile_n, mode == "interpret")
 
 
 class DeviceRetriever:
@@ -305,9 +383,7 @@ class DeviceRetriever:
         import jax
         import jax.numpy as jnp
 
-        if interpret is None:
-            interpret = jax.default_backend() != "tpu"
-        self._interpret = bool(interpret)
+        self._mode = _resolve_topk_mode(interpret)
         it = np.asarray(items, dtype=np.float32)
         self.n_total, self.dim = it.shape
         it, self._tile_n = _pad_items(it, self.n_total, tile_n)
@@ -316,8 +392,10 @@ class DeviceRetriever:
     def topk(self, queries, k: int):
         """(values [B, k], indices [B, k]) — indices -1 beyond catalog."""
         q = np.asarray(queries, dtype=np.float32)
+        if self._mode == "xla":
+            return _run_topk_xla(q, self._items, self.n_total, k)
         return _run_topk(q, self._items, self.n_total, k, self._tile_n,
-                         self._interpret)
+                         self._mode == "interpret")
 
 
 class ShardedDeviceRetriever:

@@ -1,5 +1,7 @@
-"""Fused top-k retrieval kernel (ops/retrieval.py), interpret mode on the
-CPU backend — values and indices must match exact numpy scoring."""
+"""Top-k retrieval (ops/retrieval.py) on the CPU backend: the Pallas
+kernel under interpret mode (TPU-semantics parity) AND the plain-XLA
+serving path non-TPU backends default to — both must match exact numpy
+scoring through the same output contract."""
 
 import numpy as np
 import pytest
@@ -14,16 +16,18 @@ def exact_topk(q, items, k):
     return vals, idx
 
 
+@pytest.mark.parametrize("interpret", [True, None],
+                         ids=["kernel", "default-xla"])
 @pytest.mark.parametrize("B,N,D,k", [
     (1, 100, 10, 5),       # tiny, unpadded everything
     (3, 1000, 32, 10),     # N not a multiple of the tile
     (8, 512, 64, 512),     # k == N (full ranking)
     (2, 2000, 16, 1),      # k = 1
 ])
-def test_matches_exact(rng, B, N, D, k):
+def test_matches_exact(rng, B, N, D, k, interpret):
     q = rng.standard_normal((B, D)).astype(np.float32)
     items = rng.standard_normal((N, D)).astype(np.float32)
-    vals, idx = topk_scores(q, items, k, tile_n=512)
+    vals, idx = topk_scores(q, items, k, tile_n=512, interpret=interpret)
     want_v, want_i = exact_topk(q, items, k)
     np.testing.assert_allclose(vals, want_v, rtol=1e-5, atol=1e-5)
     # indices may differ on exact ties; compare score-at-index instead
@@ -32,19 +36,23 @@ def test_matches_exact(rng, B, N, D, k):
     assert (idx >= 0).all() and (idx < N).all()
 
 
-def test_single_query_vector(rng):
+@pytest.mark.parametrize("interpret", [True, None],
+                         ids=["kernel", "default-xla"])
+def test_single_query_vector(rng, interpret):
     q = rng.standard_normal(24).astype(np.float32)
     items = rng.standard_normal((300, 24)).astype(np.float32)
-    vals, idx = topk_scores(q, items, 7)
+    vals, idx = topk_scores(q, items, 7, interpret=interpret)
     assert vals.shape == (7,) and idx.shape == (7,)
     want = np.sort(items @ q)[::-1][:7]
     np.testing.assert_allclose(vals, want, rtol=1e-5, atol=1e-5)
 
 
-def test_k_larger_than_catalog(rng):
+@pytest.mark.parametrize("interpret", [True, None],
+                         ids=["kernel", "default-xla"])
+def test_k_larger_than_catalog(rng, interpret):
     q = rng.standard_normal((2, 8)).astype(np.float32)
     items = rng.standard_normal((5, 8)).astype(np.float32)
-    vals, idx = topk_scores(q, items, 20)
+    vals, idx = topk_scores(q, items, 20, interpret=interpret)
     assert vals.shape == (2, 5)
     want_v, _ = exact_topk(q, items, 5)
     np.testing.assert_allclose(vals, want_v, rtol=1e-5, atol=1e-5)
@@ -56,9 +64,11 @@ def test_empty_catalog():
     assert vals.shape == (2, 0) and idx.shape == (2, 0)
 
 
-def test_device_retriever_reuse(rng):
+@pytest.mark.parametrize("interpret", [True, None],
+                         ids=["kernel", "default-xla"])
+def test_device_retriever_reuse(rng, interpret):
     items = rng.standard_normal((777, 48)).astype(np.float32)
-    r = DeviceRetriever(items)
+    r = DeviceRetriever(items, interpret=interpret)
     for _ in range(2):  # second call hits the jit cache
         q = rng.standard_normal((4, 48)).astype(np.float32)
         vals, idx = r.topk(q, 9)
