@@ -210,7 +210,7 @@ def _raw_xla_call(n_total: int, k: int):
     return run
 
 
-@functools.partial(functools.lru_cache(maxsize=32))
+@functools.lru_cache(maxsize=32)
 def _build_xla_call(n_total, k):
     """Jitted XLA top-k behind the shared packing policy. Keyed on
     (n_total, k) only: jit itself retraces per input shape under the
