@@ -244,3 +244,16 @@ def test_sharded_similarity_retriever_matches_host(rng):
                                [s for _, s in host], rtol=1e-5, atol=1e-6)
     # serialization still strips the device handle
     assert "_sim_retriever" not in m.__getstate__()
+
+
+def test_device_seconds_xla_mode(rng):
+    """topk_device_seconds must spin the XLA call for an xla-mode
+    retriever (the non-TPU serving default) — the kernel-path spin would
+    rebuild the interpret kernel and time the wrong program."""
+    from predictionio_tpu.ops.retrieval import topk_device_seconds
+
+    items = rng.standard_normal((400, 32)).astype(np.float32)
+    r = DeviceRetriever(items)  # CPU backend -> xla mode
+    assert r._mode == "xla"
+    dt = topk_device_seconds(r, 5, iters=4)
+    assert 0 < dt < 60
