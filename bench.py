@@ -1018,8 +1018,9 @@ def cpu_floor() -> float:
     )
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                PYTHONPATH=os.path.dirname(os.path.abspath(__file__)))
-    # single-device floor by convention: the cpu-fallback mode forces an
-    # 8-device flag into the parent env that must not leak into the child
+    # single-device floor by convention: a force-flag inherited from the
+    # launch environment (the repo's test/verify recipe exports one)
+    # must not re-widen the child's mesh
     env["XLA_FLAGS"] = re.sub(
         r"--xla_force_host_platform_device_count=\d+", "",
         env.get("XLA_FLAGS", "")).strip()
@@ -1208,17 +1209,27 @@ def main() -> None:
             time.sleep(45)
     else:
         # the artifact must not be empty OR a silent hang: run the whole
-        # bench on the virtual CPU mesh at reduced scale, clearly labeled
+        # bench on the host CPU at reduced scale, clearly labeled
         log("accelerator unreachable — falling back to a LABELED CPU run "
-            "(virtual 8-device mesh, reduced scale); the value below is "
-            "NOT a TPU number")
+            "(single device, reduced scale); the value below is NOT a "
+            "TPU number")
         platform = "cpu-fallback"
         import jax
 
         # config, not env: children (floor, sharding, ingest) must not
-        # inherit a virtual-device flag meant for this process only
+        # inherit a platform meant for this process only. SINGLE device,
+        # matching the cpu floor's convention: timing the in-process run
+        # on an 8-wide virtual mesh made vs_baseline report the
+        # virtualization overhead (measured 0.5x on a 1-core host), not
+        # information — the multi-device program is exercised by the
+        # factor-sharding child on its own virtual mesh either way.
+        # An inherited force-flag (the repo's test/verify recipe exports
+        # one) would silently re-widen this process's mesh at backend
+        # init — strip it; the virtual-mesh children re-add their own.
+        os.environ["XLA_FLAGS"] = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", "",
+            os.environ.get("XLA_FLAGS", "")).strip()
         jax.config.update("jax_platforms", "cpu")
-        jax.config.update("jax_num_cpu_devices", 8)
     enable_compile_cache()
     # bf16 is EMULATED on CPU (an order of magnitude slower than f32
     # there); each substrate runs its natural best configuration, and the
