@@ -2,6 +2,7 @@
 
 import asyncio
 import threading
+from datetime import datetime, timedelta, timezone
 
 
 class ServerThread:
@@ -43,3 +44,48 @@ class ServerThread:
 
         asyncio.run_coroutine_threadsafe(_stop(), self._loop)
         self._thread.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# Shared fixtures for the property-folding and layout tests.
+
+T0 = datetime(2020, 1, 1, tzinfo=timezone.utc)
+
+
+def special(event, eid, props, minutes):
+    """A $set/$unset/$delete event `minutes` past the shared T0 epoch —
+    the LEventAggregatorSpec-style factory used by test_aggregate and
+    test_properties."""
+    from predictionio_tpu.storage import DataMap, Event
+
+    return Event(
+        event=event,
+        entity_type="user",
+        entity_id=eid,
+        properties=DataMap(props),
+        event_time=T0 + timedelta(minutes=minutes),
+    )
+
+
+def assert_layout_invariants(lay, other, vals, n):
+    """The bilinear-layout per-side contract asserted by BOTH the
+    deterministic no-loss test (test_als) and the hypothesis search
+    (test_properties) — one home so the two cannot drift: nothing
+    dropped, every entity exactly one in-range slot, neighbor ids in
+    the other side's slot space with padding at its zero slot, chunked
+    owner segments sorted, and the full value multiset preserved."""
+    import numpy as np
+
+    assert lay.dropped == 0
+    assert sum(int(b.mask.sum()) for b in lay.buckets) == n
+    assert len(set(lay.pos.tolist())) == len(lay.pos)
+    assert lay.pos.max() < lay.slots
+    got = []
+    for b, m in zip(lay.buckets, lay.metas):
+        assert b.ids.max() < other.slots
+        assert (b.ids[b.vals == 0] == other.zero_slot).all()
+        got.append(b.vals[b.vals != 0])
+        if m.seg is not None:
+            assert (np.diff(m.seg) >= 0).all()
+            assert m.seg.max() < m.span
+    np.testing.assert_allclose(np.sort(np.concatenate(got)), np.sort(vals))

@@ -3,27 +3,15 @@ PEventAggregatorSpec (data/src/test/.../LEventAggregatorSpec.scala), plus
 monoid shard-merge properties the reference exercises via aggregateByKey."""
 
 import random
-from datetime import datetime, timedelta, timezone
+from datetime import timedelta
 
 from predictionio_tpu.storage import (
-    DataMap,
     Event,
     EventOp,
     aggregate_properties,
     aggregate_properties_single,
 )
-
-T0 = datetime(2020, 1, 1, tzinfo=timezone.utc)
-
-
-def special(event, eid, props, minutes):
-    return Event(
-        event=event,
-        entity_type="user",
-        entity_id=eid,
-        properties=DataMap(props),
-        event_time=T0 + timedelta(minutes=minutes),
-    )
+from tests.helpers import T0, special
 
 
 def test_set_merge_latest_wins():
@@ -141,68 +129,3 @@ def test_monoid_merge_order_independent():
             assert got.to_dict() == expected["u1"].to_dict()
             assert got.first_updated == expected["u1"].first_updated
             assert got.last_updated == expected["u1"].last_updated
-
-
-# ---------------------------------------------------------------------------
-# Property-based: the monoid's shard-safety claim, under adversarial
-# timestamp ties and key collisions (hypothesis searches the space the
-# hand-written shard tests sample).
-
-import pytest  # noqa: E402
-
-hypothesis = pytest.importorskip("hypothesis")  # optional dep: skip, not
-# collection-error, where it is absent (the repo convention, test_native)
-from hypothesis import given, settings, strategies as st  # noqa: E402
-
-_special_events = st.lists(
-    st.tuples(
-        st.sampled_from(["$set", "$unset", "$delete"]),
-        # tiny pools force key collisions and timestamp TIES — the
-        # regime where a non-commutative merge would diverge
-        st.dictionaries(st.sampled_from("abc"), st.integers(0, 2),
-                        min_size=0, max_size=2),
-        st.integers(0, 4),  # minutes: only 5 distinct times
-    ),
-    min_size=0, max_size=14,
-)
-
-
-def _resolve(op):
-    pm = op.to_property_map()
-    return None if pm is None else (pm.to_dict(), pm.first_updated,
-                                    pm.last_updated)
-
-
-@settings(max_examples=200, deadline=None)
-@given(evs=_special_events, seed=st.integers(0, 2**32 - 1))
-def test_monoid_partition_and_order_invariant(evs, seed):
-    """Any partition of the event stream into shards, each folded
-    locally and merged in any order, must resolve to the same entity
-    state as the sequential fold — the exact property that makes
-    aggregate_properties safe to parallelize over processes (reference
-    aggregateByKey's contract)."""
-    events = [special(e, "u1", p, m) for e, p, m in evs]
-
-    sequential = EventOp()
-    for e in events:
-        sequential = sequential.merge(EventOp.from_event(e))
-
-    rng = random.Random(seed)
-    n_shards = rng.randint(1, 4)
-    shards = [EventOp() for _ in range(n_shards)]
-    for e in events:
-        i = rng.randrange(n_shards)
-        shards[i] = shards[i].merge(EventOp.from_event(e))
-    rng.shuffle(shards)
-    merged = EventOp()
-    for s in shards:
-        merged = merged.merge(s)
-
-    assert _resolve(merged) == _resolve(sequential)
-
-    # full associativity at the EventOp level too: right-fold == left-fold
-    ops = [EventOp.from_event(e) for e in events]
-    right = EventOp()
-    for op in reversed(ops):
-        right = op.merge(right)
-    assert _resolve(right) == _resolve(sequential)

@@ -174,20 +174,13 @@ def test_bilinear_layout_no_loss():
     vals = rng.random(len(rows)).astype(np.float32) + 0.5
     u_lay, i_lay = build_bilinear_layout(rows, cols, vals, nu, ni,
                                          tiers=(8, 64, 256), chunk_cap=64)
+    from tests.helpers import assert_layout_invariants
+
     for lay, other in ((u_lay, i_lay), (i_lay, u_lay)):
-        total = sum(b.mask.sum() for b in lay.buckets)
-        assert total == len(rows)  # nothing dropped
-        # every true row has exactly one slot, all distinct, in range
-        assert len(set(lay.pos.tolist())) == len(lay.pos)
-        assert lay.pos.max() < lay.slots
-        # neighbor ids live in the other side's slot space; padded slots
-        # point at its zero slot
-        for b, m in zip(lay.buckets, lay.metas):
-            assert b.ids.max() < other.slots
-            assert (b.ids[b.vals == 0] == other.zero_slot).all()
-            if m.seg is not None:  # chunked tier: sorted owner segments
-                assert (np.diff(m.seg) >= 0).all()
-                assert m.seg.max() < m.span
+        # per-side contract (shared with the hypothesis search in
+        # test_properties): no loss, slot permutation, neighbor ids in
+        # the other side's slot space, sorted chunk segments
+        assert_layout_invariants(lay, other, vals, len(rows))
     # user 0 (degree 200 > chunk_cap 64) is chunked: its entries spread
     # over several block rows that all segment-sum into one owner slot
     chunked = [m for m in u_lay.metas if m.seg is not None]
@@ -195,13 +188,6 @@ def test_bilinear_layout_no_loss():
     # align: slot counts must divide by any model-axis size (lcm with 8)
     u5, i5 = build_bilinear_layout(rows, cols, vals, nu, ni, align=5)
     assert u5.slots % 40 == 0 and i5.slots % 40 == 0
-    # reconstruct: every (row, col, val) triple present exactly once
-    seen = []
-    for b in u_lay.buckets:
-        nb_mask = b.vals != 0
-        seen.append(b.vals[nb_mask])
-    got = np.sort(np.concatenate(seen))
-    assert np.allclose(got, np.sort(vals))
 
 
 def test_solver_parity_cg_vs_exact(rng):

@@ -1,0 +1,110 @@
+"""Hypothesis property tests for the two host-side structures whose
+parallelization contracts are pure invariants: the EventOp aggregation
+monoid (shard-safety) and the bilinear neighbor layout (no-loss slot
+permutation). Isolated in their own module so a hypothesis-less
+environment skips exactly these tests, not their subjects' suites."""
+
+import random
+
+import numpy as np
+import pytest
+
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+from predictionio_tpu.storage import EventOp  # noqa: E402
+from tests.helpers import assert_layout_invariants, special  # noqa: E402
+
+
+# ---------------------------------------------------------------------------
+# EventOp monoid: the shard-safety claim, under adversarial timestamp
+# ties and key collisions (the regime where a non-commutative merge
+# would diverge).
+
+_special_events = st.lists(
+    st.tuples(
+        st.sampled_from(["$set", "$unset", "$delete"]),
+        # tiny pools force key collisions and timestamp TIES
+        st.dictionaries(st.sampled_from("abc"), st.integers(0, 2),
+                        min_size=0, max_size=2),
+        st.integers(0, 4),  # minutes: only 5 distinct times
+    ),
+    min_size=0, max_size=14,
+)
+
+
+def _resolve(op):
+    pm = op.to_property_map()
+    return None if pm is None else (pm.to_dict(), pm.first_updated,
+                                    pm.last_updated)
+
+
+@settings(max_examples=200, deadline=None)
+@given(evs=_special_events, seed=st.integers(0, 2**32 - 1))
+def test_monoid_partition_and_order_invariant(evs, seed):
+    """Any partition of the event stream into shards, each folded
+    locally and merged in any order, must resolve to the same entity
+    state as the sequential fold — the property that makes
+    aggregate_properties safe to parallelize over processes (the
+    reference aggregateByKey's contract)."""
+    events = [special(e, "u1", p, m) for e, p, m in evs]
+
+    sequential = EventOp()
+    for e in events:
+        sequential = sequential.merge(EventOp.from_event(e))
+
+    rng = random.Random(seed)
+    n_shards = rng.randint(1, 4)
+    shards = [EventOp() for _ in range(n_shards)]
+    for e in events:
+        i = rng.randrange(n_shards)
+        shards[i] = shards[i].merge(EventOp.from_event(e))
+    rng.shuffle(shards)
+    merged = EventOp()
+    for s in shards:
+        merged = merged.merge(s)
+
+    assert _resolve(merged) == _resolve(sequential)
+
+    # full associativity at the EventOp level too: right-fold == left-fold
+    ops = [EventOp.from_event(e) for e in events]
+    right = EventOp()
+    for op in reversed(ops):
+        right = op.merge(right)
+    assert _resolve(right) == _resolve(sequential)
+
+
+# ---------------------------------------------------------------------------
+# Bilinear layout: the invariants of test_als.test_bilinear_layout_no_loss,
+# searched over random shapes, skew, tier ladders, and alignments.
+
+
+@settings(max_examples=60, deadline=None)
+@given(
+    nu=st.integers(1, 20), ni=st.integers(1, 15),
+    n=st.integers(1, 200), seed=st.integers(0, 999),
+    heavy=st.booleans(),  # pile entries on one row to force chunking
+    tiers=st.sampled_from([(4,), (4, 16), (8, 64)]),
+    chunk_cap=st.sampled_from([4, 16]),
+    align=st.sampled_from([1, 5]),
+)
+def test_bilinear_layout_invariants_property(nu, ni, n, seed, heavy, tiers,
+                                             chunk_cap, align):
+    """Every random instance must keep the full entry multiset, assign
+    each entity exactly one in-range slot, remap neighbor ids into the
+    other side's slot space (padding at its zero slot), keep chunked-tier
+    owner segments sorted, and honor the model-axis alignment."""
+    from predictionio_tpu.ops.neighbors import build_bilinear_layout
+
+    rng = np.random.default_rng(seed)
+    rows = rng.integers(0, nu, n).astype(np.int64)
+    if heavy:
+        rows[: n // 2] = rng.integers(0, nu)  # one hot row
+    cols = rng.integers(0, ni, n).astype(np.int64)
+    vals = (rng.random(n).astype(np.float32) + 0.5)
+    u_lay, i_lay = build_bilinear_layout(rows, cols, vals, nu, ni,
+                                         tiers=tiers, chunk_cap=chunk_cap,
+                                         align=align)
+    for lay, other in ((u_lay, i_lay), (i_lay, u_lay)):
+        assert_layout_invariants(lay, other, vals, n)
+        assert lay.slots % np.lcm(align, 8) == 0
