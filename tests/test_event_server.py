@@ -371,48 +371,87 @@ def test_stats_read_paths_do_not_book(server, app_key):
     assert body["statusCount"] == {}
 
 
-def test_concurrent_batch_ingest_counts_exact(server, app_key):
-    """N client threads hammering /batch/events.json concurrently must
-    land every event exactly once and book every outcome in stats —
-    the ingest plane's thread-safety contract (the reference's
-    EventServiceActor serializes through akka; here the asyncio loop +
-    storage backend must cope with interleaved client connections)."""
-    app, key = app_key
-    n_threads, n_rounds, per_batch = 6, 5, 20
-    url = f"{server.url}/batch/events.json?accessKey={key}"
+def _hammer_batches(url, n_threads, n_rounds, per_batch, prefix):
+    """Shared scaffold of the concurrency tests: N daemon client threads
+    posting batches with distinct entity ids; returns the error list
+    (request timeouts + daemon threads so a wedged server fails the
+    test instead of hanging the interpreter at shutdown)."""
     errors = []
 
     def client(t):
         try:
-            s = requests.Session()
+            sess = requests.Session()
             for r_i in range(n_rounds):
-                batch = [dict(EV, entityId=f"u{t}_{r_i}_{j}")
+                batch = [dict(EV, entityId=f"{prefix}{t}_{r_i}_{j}")
                          for j in range(per_batch)]
-                resp = s.post(url, json=batch)
-                if resp.status_code != 200:
-                    errors.append(resp.status_code)
-                elif any(x["status"] != 201 for x in resp.json()):
-                    errors.append(resp.json())
+                resp = sess.post(url, json=batch, timeout=30)
+                if resp.status_code != 200 or any(
+                        x["status"] != 201 for x in resp.json()):
+                    errors.append(resp.text[:200])
         except Exception as e:  # noqa: BLE001 — must reach the assert
             errors.append(repr(e))
 
-    threads = [threading.Thread(target=client, args=(t,))
+    threads = [threading.Thread(target=client, args=(t,), daemon=True)
                for t in range(n_threads)]
     for t in threads:
         t.start()
     for t in threads:
         t.join(timeout=60)
     assert not any(t.is_alive() for t in threads)  # no hung request
+    return errors
+
+
+def test_concurrent_batch_ingest_counts_exact(server, app_key):
+    """N client threads hammering /batch/events.json concurrently must
+    land every event exactly once and book every outcome in stats —
+    the ingest plane's thread-safety contract (the reference's
+    EventServiceActor serializes through akka; here the asyncio loop +
+    storage backend must cope with interleaved client connections)."""
+    from predictionio_tpu.storage.events_base import EventQuery
+
+    app, key = app_key
+    n_threads, n_rounds, per_batch = 6, 5, 20
+    errors = _hammer_batches(
+        f"{server.url}/batch/events.json?accessKey={key}",
+        n_threads, n_rounds, per_batch, "u")
     assert not errors
     total = n_threads * n_rounds * per_batch
-
-    from predictionio_tpu.storage.events_base import EventQuery
 
     got = list(Storage.get_events().find(EventQuery(app.id, limit=-1)))
     assert len(got) == total
     # every entity id landed exactly once — no lost or duplicated writes
-    ids = [e.entity_id for e in got]
-    assert len(set(ids)) == total
+    assert len({e.entity_id for e in got}) == total
 
-    stats = requests.get(f"{server.url}/stats.json?accessKey={key}").json()
+    stats = requests.get(f"{server.url}/stats.json?accessKey={key}",
+                         timeout=30).json()
     assert stats["statusCount"]["201"] == total
+
+
+def test_concurrent_batch_ingest_sqlite(tmp_path):
+    """The same exact-count contract on the DURABLE backend: sqlite's
+    per-thread connections + write lock must serialize interleaved
+    client batches without losing or duplicating a row."""
+    from predictionio_tpu.storage.events_base import EventQuery
+
+    Storage.reset()
+    Storage.configure("METADATA", "sqlite", path=str(tmp_path / "meta.db"))
+    Storage.configure("EVENTDATA", "sqlite", path=str(tmp_path / "ev.db"))
+    meta = Storage.get_metadata()
+    app = meta.app_insert("sq")
+    key = meta.access_key_insert(app.id).key
+    Storage.get_events().init_app(app.id)
+    s = _ServerThread(stats=False)
+    try:
+        n_threads, n_rounds, per_batch = 4, 4, 10
+        errors = _hammer_batches(
+            f"{s.url}/batch/events.json?accessKey={key}",
+            n_threads, n_rounds, per_batch, "s")
+        assert not errors
+        total = n_threads * n_rounds * per_batch
+        got = list(Storage.get_events().find(EventQuery(app.id, limit=-1)))
+        assert len(got) == total
+        assert len({e.entity_id for e in got}) == total
+    finally:
+        s.stop()
+    # (storage reset back to memory backends is the autouse
+    # clean_storage fixture's job — conftest.py)
