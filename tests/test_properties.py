@@ -108,3 +108,56 @@ def test_bilinear_layout_invariants_property(nu, ni, n, seed, heavy, tiers,
     for lay, other in ((u_lay, i_lay), (i_lay, u_lay)):
         assert_layout_invariants(lay, other, vals, n)
         assert lay.slots % np.lcm(align, 8) == 0
+
+
+# ---------------------------------------------------------------------------
+# Event wire codec: to_api_dict ∘ from_api_dict must be the identity on
+# every valid event — searched over unicode ids, nested property values,
+# and sub-second timestamps (the SDK-facing JSON contract).
+
+from datetime import datetime, timezone  # noqa: E402
+
+_json_scalars = st.one_of(st.booleans(), st.integers(-1000, 1000),
+                          st.floats(-1e6, 1e6, allow_nan=False),
+                          st.text(max_size=8))
+_json_values = st.recursive(
+    _json_scalars,
+    lambda children: st.one_of(
+        st.lists(children, max_size=3),
+        st.dictionaries(st.text(min_size=1, max_size=6), children,
+                        max_size=3)),
+    max_leaves=8)
+_ids = st.text(min_size=1, max_size=12).filter(
+    lambda s: s.strip() == s and s and not s.startswith(("$", "pio_")))
+
+
+@settings(max_examples=150, deadline=None)
+@given(
+    event=st.sampled_from(["view", "rate", "like", "$set"]),
+    eid=_ids, etype=_ids,
+    props=st.dictionaries(
+        st.text(min_size=1, max_size=8).filter(
+            lambda k: not k.startswith(("$", "pio_"))),
+        _json_values, max_size=4),
+    micros=st.integers(0, 999_999),
+    tags=st.lists(_ids, max_size=3),
+)
+def test_event_wire_codec_roundtrip(event, eid, etype, props, micros, tags):
+    from predictionio_tpu.storage import DataMap
+    from predictionio_tpu.storage.event import (
+        Event, event_from_api_dict, event_to_api_dict)
+
+    e = Event(
+        event=event, entity_type=etype, entity_id=eid,
+        properties=DataMap(props),
+        event_time=datetime(2021, 3, 4, 5, 6, 7, micros,
+                            tzinfo=timezone.utc),
+        tags=tuple(tags),
+    )
+    e2 = event_from_api_dict(event_to_api_dict(e))
+    assert e2.event == e.event
+    assert e2.entity_type == e.entity_type and e2.entity_id == e.entity_id
+    assert e2.properties == e.properties
+    assert e2.tags == e.tags
+    # sub-second precision must survive the ISO text form
+    assert e2.event_time == e.event_time
