@@ -754,15 +754,15 @@ print("E2E", time.time() - t_all)
         # the CLI's local-mode escape hatch (tools/cli.py): the child
         # pins its backend to the host before any verb touches a device,
         # so the fallback artifact gets an e2e row even when the
-        # accelerator is wedged (needs_device=False is then honest).
-        # Child budget 850s, not 1800: cold+warm share ONE 1800s
-        # run_joined deadline in fallback mode — two full-budget
-        # children could measure the cold run and still lose BOTH rows
-        # to the phase deadline mid-warm.
+        # accelerator is wedged (needs_device=False is then honest)
         env["PIO_PLATFORM"] = "cpu"
+    # Child budget 850s, not 1800, in BOTH modes: cold+warm share ONE
+    # 1800s run_joined deadline — two full-budget children could measure
+    # the cold run and still lose BOTH rows to the phase deadline
+    # mid-warm. (The TPU path used to get the whole 1800s and could
+    # starve the warm run the same way.)
     out = run_child([sys.executable, "-c", code], env=env,
-                    timeout=850 if force_cpu else 1800,
-                    needs_device=not force_cpu)
+                    timeout=850, needs_device=not force_cpu)
     for line in out.stdout.splitlines():
         if line.startswith("E2E "):
             s = float(line.split()[1])
@@ -860,53 +860,44 @@ for shape, model_sharded in (((8, 1), False), ((4, 2), True)):
 
 
 def sharded_retrieval_bench() -> dict:
-    """VERDICT r4 item 3: the model-sharded serving path's first perf
-    rows. ShardedDeviceRetriever.topk (catalog sharded over a model
-    axis, per-shard top-k + one O(B*P*k) all-gather merge) at 8-way vs
-    1-way sharding on the SAME platform, catalog, and code path — run on
-    the virtual 8-device CPU mesh in a subprocess (multi-chip hardware
-    is not available; numerics parity with host scoring is pinned by
-    tests/test_retrieval.py and the multichip dryrun). The 1-way point
-    is the unsharded baseline of the same XLA program, so the delta
-    isolates exactly the sharding overhead (shard_map + collective
-    merge) with no other code-path difference."""
+    """VERDICT r4 item 3 / r5 inversion closure: the model-sharded
+    serving path's perf rows, now a 1/2/4/8-way SWEEP through
+    tools/serve_bench.sweep — the same code path `pio bench serve` and
+    the engine server run (ShardedDeviceRetriever with the cross-shard
+    merge INSIDE shard_map, one packed all-gather, AOT-prewarmed
+    executables). Runs on the virtual 8-device CPU mesh in a subprocess
+    (multi-chip hardware is not available; bitwise parity with the
+    single-device retriever is pinned by tests/test_retrieval.py). The
+    1-way point is the unsharded baseline of the same XLA program, so
+    each delta isolates exactly the sharding overhead. Batch 128, not
+    64: per-shard score blocks stay cache-resident at 128 where the
+    1-way [B, n_items] block does not — the serving regime the r5
+    inversion hid (docs/PERF_NOTES.md)."""
     code = _VMESH_PREAMBLE + r"""
-from predictionio_tpu.ops.retrieval import ShardedDeviceRetriever
-from predictionio_tpu.parallel.mesh import make_mesh
+from predictionio_tpu.tools.serve_bench import sweep
 
-rng = np.random.default_rng(7)
-# sized for the CPU substrate this section actually runs on (the bench
-# host is a 1-core box; the TPU-scale catalog point is catalog_1m_latency)
-n_items, rank, B = 65_536, 64, 64
-items = (rng.normal(size=(n_items, rank)) / np.sqrt(rank)).astype(np.float32)
-q = (rng.normal(size=(B, rank)) / np.sqrt(rank)).astype(np.float32)
-
-for label, width in (("1way", 1), ("8way", 8)):
-    mesh = make_mesh((width,), ("model",))
-    ret = ShardedDeviceRetriever(items, mesh)
-    vals, idx = ret.topk(q, 10)  # compile
-    np.asarray(vals)
-    lat = []
-    for _ in range(12):
-        t0 = time.perf_counter()
-        vals, idx = ret.topk(q, 10)
-        np.asarray(vals)  # host pull fence, like serving does
-        lat.append(time.perf_counter() - t0)
-    lat.sort()
-    p50 = lat[len(lat) // 2]
-    print("SHARDEDRET %s %.3f %.1f" % (label, p50 * 1e3, B / p50))
+for r in sweep((1, 2, 4, 8)):
+    print("SHARDEDRET %d %.3f %.1f %s %.4f %d" % (
+        r["ways"], r["p50_ms"], r["qps"], r["merge"],
+        r["exec_cache_hit_rate"], r["batch"]))
 """
     res = {}
-    for label, p50_ms, qps in _run_tagged_child(code, "SHARDEDRET", 900):
-        res[f"sharded_topk_{label}_p50_ms"] = float(p50_ms)
-        res[f"sharded_topk_{label}_qps"] = round(float(qps))
-    if len(res) != 4:
+    rows = _run_tagged_child(code, "SHARDEDRET", 900)
+    for ways, p50_ms, qps, merge, hit_rate, batch in rows:
+        res[f"sharded_topk_{ways}way_p50_ms"] = float(p50_ms)
+        res[f"sharded_topk_{ways}way_qps"] = round(float(qps))
+        res["sharded_topk_merge"] = merge
+        res["sharded_topk_exec_cache_hit_rate"] = float(hit_rate)
+        res["sharded_topk_batch"] = int(batch)
+    if len(res) != 11:  # 4 ways x 2 + 3 shared fields
         raise RuntimeError(f"sharded retrieval bench incomplete: {res}")
-    log(f"sharded retrieval (64k x 64 catalog, batch-64 top-10, virtual "
-        f"CPU mesh): 1-way p50 {res['sharded_topk_1way_p50_ms']:.2f} ms "
-        f"({res['sharded_topk_1way_qps']} qps) vs 8-way-sharded p50 "
-        f"{res['sharded_topk_8way_p50_ms']:.2f} ms "
-        f"({res['sharded_topk_8way_qps']} qps)")
+    log(f"sharded retrieval sweep (64k x 64 catalog, batch-128 top-10, "
+        f"virtual CPU mesh, merge={res['sharded_topk_merge']}, exec-cache "
+        f"hit rate {res['sharded_topk_exec_cache_hit_rate']:.2f}): "
+        + "; ".join(
+            f"{w}-way p50 {res[f'sharded_topk_{w}way_p50_ms']:.2f} ms "
+            f"({res[f'sharded_topk_{w}way_qps']} qps)"
+            for w in (1, 2, 4, 8)))
     return res
 
 
@@ -1172,7 +1163,12 @@ def main() -> None:
 
     def emit(wedged_in: str | None = None) -> None:
         with state_lock:
-            result, extras = dict(state["result"]), dict(state["extras"])
+            result = dict(state["result"])
+            # deep-copy list values (budget_skipped, phase_timeouts):
+            # a shallow dict copy still aliases them, and the main
+            # thread appends while the watchdog serializes
+            extras = {k: list(v) if isinstance(v, list) else v
+                      for k, v in state["extras"].items()}
             value, vs = state["value"], state["vs"]
             cdt, platform, gap = state["cdt"], state["platform"], state["gap"]
         if wedged_in:

@@ -23,11 +23,14 @@ path the kernel tests pin TPU semantics with.
 from __future__ import annotations
 
 import functools
+import itertools
+import threading
 
 import numpy as np
 
 __all__ = ["topk_scores", "DeviceRetriever", "ShardedDeviceRetriever",
-           "RetrievalServingMixin", "row_normalize"]
+           "RetrievalServingMixin", "row_normalize", "ExecutableCache",
+           "EXEC_CACHE"]
 
 
 def row_normalize(x: np.ndarray) -> np.ndarray:
@@ -42,6 +45,103 @@ def row_normalize(x: np.ndarray) -> np.ndarray:
 #: packed single-pull result buffer would corrupt indices, so callers
 #: fall back to the two-buffer path. One home for both retrievers.
 PACKED_IDX_LIMIT = 1 << 24
+
+
+class ExecutableCache:
+    """THE bounded cache of compiled top-k serving executables — one home
+    for what used to be three ad-hoc caches (`_build_call`'s lru_cache,
+    `_build_xla_call`'s lru_cache, and ShardedDeviceRetriever's `_calls`
+    dict), so a long-lived server has ONE executable budget and ONE set
+    of hit/miss/eviction counters (surfaced through the engine server's
+    /stats.json and the bench's emitted config).
+
+    Keys are namespaced tuples carrying every shape the executable was
+    specialized on. Entries pinned via ``pin()`` (the deploy path's
+    AOT-pre-warmed hot serving shapes) are skipped by LRU eviction, so
+    shape churn from odd client batch sizes can never evict the hot
+    shape; the pin set itself is bounded (oldest pin unpinned past
+    ``PIN_LIMIT``) so repeated /reloads of token-keyed sharded entries
+    cannot grow it without bound.
+    """
+
+    PIN_LIMIT = 16
+
+    def __init__(self, maxsize: int = 64):
+        self.maxsize = max(1, maxsize)
+        self._entries: dict = {}  # insertion order = LRU order
+        self._pinned: dict = {}   # ordered set of pinned keys
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def get_or_build(self, key, build):
+        """Return the cached value for ``key``, building (and inserting)
+        it on a miss. ``build()`` runs OUTSIDE the lock — compiles take
+        seconds and must not serialize the serving threads; two threads
+        racing the same key may both compile, first insert wins."""
+        with self._lock:
+            if key in self._entries:
+                self.hits += 1
+                val = self._entries.pop(key)
+                self._entries[key] = val  # re-insert at the recent end
+                return val
+            self.misses += 1
+        val = build()
+        with self._lock:
+            if key in self._entries:
+                return self._entries[key]  # lost the build race
+            while len(self._entries) >= self.maxsize:
+                victim = next((k for k in self._entries
+                               if k not in self._pinned), None)
+                if victim is None:
+                    break  # everything pinned: admit over budget
+                self._entries.pop(victim)
+                self.evictions += 1
+            self._entries[key] = val
+        return val
+
+    def pin(self, key) -> None:
+        """Exempt ``key`` from eviction (hot serving shapes)."""
+        with self._lock:
+            self._pinned.pop(key, None)
+            self._pinned[key] = True
+            while len(self._pinned) > self.PIN_LIMIT:
+                self._pinned.pop(next(iter(self._pinned)))
+
+    def stats(self) -> dict:
+        with self._lock:
+            total = self.hits + self.misses
+            return {
+                "size": len(self._entries),
+                "pinned": len(self._pinned),
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "hitRate": (self.hits / total) if total else 0.0,
+            }
+
+
+#: Process-wide singleton: every retriever in the process shares one
+#: executable budget (a server deploys several models over one backend).
+EXEC_CACHE = ExecutableCache()
+
+#: Distinguishes sharded-cache keys across retriever instances. A counter
+#: rather than id(): id() values recycle after gc, and a recycled key
+#: would serve a stale executable built over a DIFFERENT catalog.
+_RETRIEVER_TOKENS = itertools.count()
+
+#: Serializes multi-device (collective) executable launches process-wide.
+#: Two collective programs launched concurrently from different threads
+#: can interleave their per-device partitions on the backend's worker
+#: pool; each partition then blocks in a rendezvous the other program's
+#: partitions are occupying the pool for — a deadlock, not a slowdown
+#: (pinned by test_microbatch's sharded-serving hammer). The lock is held
+#: through block_until_ready so a launch fully drains before the next
+#: one starts; single-device executables have no rendezvous and bypass
+#: it. The retriever step is serialized across models either way: the
+#: programs contend for the same device set.
+_COLLECTIVE_LAUNCH_LOCK = threading.Lock()
 
 
 def _pad_to(x, mult, axis, value=0.0):
@@ -147,40 +247,50 @@ def _raw_call(B, D, N_pad, n_total, k, tile_n, interpret):
     )
 
 
-@functools.partial(
-    # bounded: a long-lived server reloading a growing catalog must not
-    # accumulate one compiled kernel per historical catalog size. 32 covers
-    # the pow2-padded batch sizes x rounded k values of steady serving.
-    functools.lru_cache(maxsize=32),
-)
-def _build_call(B, D, N_pad, n_total, k, tile_n, interpret):
-    """Jitted kernel + result packing: values and indices leave the device
-    as ONE [B, 2k] f32 buffer. On remote-dispatch platforms each blocking
-    host pull is a full round trip (measured ~67ms on the tunneled v5e) —
-    two sequential pulls would double the serving latency the kernel's
-    ~1ms of device time cannot explain. Indices are exact in f32 below
-    2^24; a larger catalog falls back to the two-buffer path."""
-    return _jit_with_packing(
-        _raw_call(B, D, N_pad, n_total, k, tile_n, interpret), n_total)
+def _build_call(B, D, N_pad, n_total, k, tile_n, interpret, *, pin=False):
+    """Compiled kernel + result packing: values and indices leave the
+    device as ONE [B, 2k] f32 buffer. On remote-dispatch platforms each
+    blocking host pull is a full round trip (measured ~67ms on the
+    tunneled v5e) — two sequential pulls would double the serving latency
+    the kernel's ~1ms of device time cannot explain. Indices are exact in
+    f32 below 2^24; a larger catalog falls back to the two-buffer path.
+    The executable is AOT-built (jit -> lower -> compile) into
+    EXEC_CACHE; ``pin=True`` (the deploy path's pre-warm) exempts the
+    shape from eviction."""
+    key = ("kernel", B, D, N_pad, n_total, k, tile_n, interpret)
+    out = EXEC_CACHE.get_or_build(key, lambda: _aot_with_packing(
+        _raw_call(B, D, N_pad, n_total, k, tile_n, interpret),
+        n_total, B, D, N_pad))
+    if pin:
+        EXEC_CACHE.pin(key)
+    return out
 
 
-def _jit_with_packing(call, n_total: int):
+def _aot_with_packing(call, n_total: int, B: int, D: int, N_pad: int):
     """The ONE home of the pack/no-pack policy for every single-device
     top-k builder (kernel and XLA): below PACKED_IDX_LIMIT, values and
     indices leave the device as one [B, 2k] f32 buffer (one host pull =
     one dispatch round trip); at/above it, the two-buffer path keeps
-    indices exact. Returns (jitted callable, is_packed)."""
+    indices exact. The executable is compiled AHEAD of the first call
+    (``jax.jit(...).lower(...).compile()``) so a pre-warmed shape never
+    pays tracing or compilation on the serving path. Returns (compiled
+    executable, is_packed)."""
     import jax
     import jax.numpy as jnp
 
     if n_total >= PACKED_IDX_LIMIT:
-        return jax.jit(call), False
+        fn, is_packed = call, False
+    else:
+        def fn(q, items):
+            vals, idx = call(q, items)
+            return jnp.concatenate([vals, idx.astype(jnp.float32)], axis=1)
 
-    def packed(q, items):
-        vals, idx = call(q, items)
-        return jnp.concatenate([vals, idx.astype(jnp.float32)], axis=1)
-
-    return jax.jit(packed), True
+        is_packed = True
+    compiled = jax.jit(fn).lower(
+        jax.ShapeDtypeStruct((B, D), jnp.float32),
+        jax.ShapeDtypeStruct((N_pad, D), jnp.float32),
+    ).compile()
+    return compiled, is_packed
 
 
 def _raw_xla_call(n_total: int, k: int):
@@ -210,22 +320,28 @@ def _raw_xla_call(n_total: int, k: int):
     return run
 
 
-@functools.lru_cache(maxsize=32)
-def _build_xla_call(n_total, k):
-    """Jitted XLA top-k behind the shared packing policy. Keyed on
-    (n_total, k) only: jit itself retraces per input shape under the
-    one returned callable, so adding shape keys would just fragment
-    the 32-entry bound."""
-    return _jit_with_packing(_raw_xla_call(n_total, k), n_total)
+def _build_xla_call(B, D, N_pad, n_total, k, *, pin=False):
+    """Compiled XLA top-k behind the shared packing policy, AOT-built
+    into EXEC_CACHE like the kernel path (full shape key: the executable
+    is compiled, not a retracing jit)."""
+    key = ("xla", B, D, N_pad, n_total, k)
+    out = EXEC_CACHE.get_or_build(key, lambda: _aot_with_packing(
+        _raw_xla_call(n_total, k), n_total, B, D, N_pad))
+    if pin:
+        EXEC_CACHE.pin(key)
+    return out
 
 
 def _run_topk_xla(q: np.ndarray, items_dev, n_total: int, k: int):
     """Single-device entry, plain-XLA path (non-TPU serving)."""
-    import jax.numpy as jnp
 
     def invoke(qp, k_pad):
-        call, is_packed = _build_xla_call(n_total, k_pad)
-        return call(jnp.asarray(qp), items_dev), is_packed
+        call, is_packed = _build_xla_call(
+            qp.shape[0], items_dev.shape[1], items_dev.shape[0],
+            n_total, k_pad)
+        # the compiled executable takes the padded numpy batch directly —
+        # no jnp.asarray bounce through the default device
+        return call(qp, items_dev), is_packed
 
     return _dispatch_topk(q, n_total, k, invoke)
 
@@ -327,14 +443,13 @@ def _dispatch_topk(q: np.ndarray, n_total: int, k: int, invoke):
 def _run_topk(q: np.ndarray, items_dev, n_total: int, k: int, tile_n: int,
               interpret: bool):
     """Single-device entry: fused Pallas kernel behind ``_dispatch_topk``."""
-    import jax.numpy as jnp
 
     def invoke(qp, k_pad):
         call, is_packed = _build_call(
             qp.shape[0], items_dev.shape[1], items_dev.shape[0], n_total,
             k_pad, tile_n, interpret,
         )
-        return call(jnp.asarray(qp), items_dev), is_packed
+        return call(qp, items_dev), is_packed
 
     return _dispatch_topk(q, n_total, k, invoke)
 
@@ -397,6 +512,34 @@ class DeviceRetriever:
         return _run_topk(q, self._items, self.n_total, k, self._tile_n,
                          self._mode == "interpret")
 
+    def prewarm(self, batch_sizes=(1,), ks=(10,)) -> list[tuple[int, int]]:
+        """AOT-build and PIN the executables for the hot serving shapes,
+        so the first query of a pre-warmed shape never pays a compile and
+        executable-cache churn can never evict it. Called by the deploy
+        path (workflow/create_server.Deployed) with the micro-batcher's
+        max_batch and the single-query pad. Returns the distinct
+        (b_pad, k_pad) shapes warmed."""
+        warmed: list[tuple[int, int]] = []
+        for b in batch_sizes:
+            for k in ks:
+                k_eff = min(k, self.n_total)
+                if b <= 0 or k_eff <= 0:
+                    continue
+                b_pad, k_pad = _query_shapes(b, k_eff, self.n_total)
+                if (b_pad, k_pad) in warmed:
+                    continue
+                if self._mode == "xla":
+                    _build_xla_call(b_pad, self._items.shape[1],
+                                    self._items.shape[0], self.n_total,
+                                    k_pad, pin=True)
+                else:
+                    _build_call(b_pad, self._items.shape[1],
+                                self._items.shape[0], self.n_total, k_pad,
+                                self._tile_n, self._mode == "interpret",
+                                pin=True)
+                warmed.append((b_pad, k_pad))
+        return warmed
+
 
 class ShardedDeviceRetriever:
     """Catalog top-k with the item matrix SHARDED over a mesh axis — the
@@ -406,17 +549,29 @@ class ShardedDeviceRetriever:
 
     Communication structure (the point of the design): each device scores
     its own [N/P, D] shard and reduces it to a local [B, k] candidate set
-    inside ``shard_map``; the only collective is the all-gather of those
-    [B, P*k] candidates for the final merge — O(B*P*k) bytes over ICI,
-    independent of catalog size. No all-reduce, no all-to-all, and the
-    [B, N] score matrix never exists globally (the reference's analog
-    ships whole factor RDD partitions through Spark's shuffle to one
-    driver-side sort, examples/scala-parallel-similarproduct/multi/src/
-    main/scala/ALSAlgorithm.scala:146-200).
+    inside ``shard_map``; the only collective is ONE all-gather of the
+    packed [B, 2k] candidate buffers for the final merge — O(B*P*k) bytes
+    over ICI, independent of catalog size. The cross-shard top-k-of-
+    candidates merge ALSO runs inside the shard_map (every device merges
+    the replicated [B, P*2k] gather redundantly — P*k is tiny), so the
+    program leaves the device as the packed [B, 2k] result: one host
+    pull, no GSPMD resharding step between the gather and the merge. No
+    all-reduce, no all-to-all, and the [B, N] score matrix never exists
+    globally (the reference's analog ships whole factor RDD partitions
+    through Spark's shuffle to one driver-side sort, examples/scala-
+    parallel-similarproduct/multi/src/main/scala/ALSAlgorithm.scala:
+    146-200).
 
-    API-compatible with ``DeviceRetriever`` (``topk``, ``n_total``): the
-    serving mixin and micro-batcher use either interchangeably.
+    API-compatible with ``DeviceRetriever`` (``topk``, ``n_total``,
+    ``prewarm``): the serving mixin and micro-batcher use either
+    interchangeably.
     """
+
+    #: Where the cross-shard candidate merge runs. "device" = inside the
+    #: shard_map program (one packed pull); the pre-r6 design merged in a
+    #: GSPMD epilogue after an explicit replication constraint. The bench
+    #: records this in its emitted config so the sweep is self-describing.
+    merge = "device"
 
     def __init__(self, items: np.ndarray, mesh, *, axis: str = "model"):
         import jax
@@ -441,19 +596,15 @@ class ShardedDeviceRetriever:
             lambda index: it[index])  # numpy slice: one direct
         # host->target-device transfer per shard (jnp.asarray here would
         # bounce every shard through the default device first)
-        self._calls: dict = {}
+        self._token = next(_RETRIEVER_TOKENS)  # EXEC_CACHE key namespace
 
-    def _call_for(self, b_pad: int, k_local: int, k_out: int):
-        key = (b_pad, k_local, k_out)
-        fn = self._calls.pop(key, None)
-        if fn is None:
-            # bounded LRU, like _build_call's lru_cache: a long-lived
-            # server must not accumulate one executable per (B, k) pair,
-            # and the hot serving shape must never be the one evicted
-            while len(self._calls) >= 32:
-                self._calls.pop(next(iter(self._calls)))
-            fn = self._build(b_pad, k_local, k_out)
-        self._calls[key] = fn  # (re)insert at the recent end
+    def _call_for(self, b_pad: int, k_local: int, k_out: int, *,
+                  pin: bool = False):
+        key = ("sharded", self._token, b_pad, k_local, k_out)
+        fn = EXEC_CACHE.get_or_build(
+            key, lambda: self._build(b_pad, k_local, k_out))
+        if pin:
+            EXEC_CACHE.pin(key)
         return fn
 
     def _build(self, b_pad: int, k_local: int, k_out: int):
@@ -467,9 +618,11 @@ class ShardedDeviceRetriever:
         from ..parallel.collectives import get_shard_map
 
         axis, n_total, S = self._axis, self.n_total, self._shard_rows
+        nsh = self._nshards
+        packed = n_total < PACKED_IDX_LIMIT
         shard_map = get_shard_map()
 
-        def local_topk(q, shard):  # q [B, D] replicated; shard [S, D]
+        def local_merge(q, shard):  # q [B, D] replicated; shard [S, D]
             scores = jax.lax.dot_general(
                 q, shard, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
@@ -480,51 +633,81 @@ class ShardedDeviceRetriever:
             cand = offset + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
             scores = jnp.where(cand < n_total, scores, -jnp.inf)
             v, i = jax.lax.top_k(scores, k_local)
-            return v, jnp.take_along_axis(cand, i, axis=1)
+            i = jnp.take_along_axis(cand, i, axis=1)
+            # the gather is shard-major, and within a shard top_k orders
+            # ties by ascending index — so candidate order in the merged
+            # buffer IS ascending global index per score, and the final
+            # top_k tie-breaks exactly like the full-catalog top_k
+            # (bitwise parity, pinned by test_sharded_bitwise_parity)
+            if packed:
+                # indices ride the gather as f32 (exact below 2^24):
+                # ONE collective instead of two
+                buf = jnp.concatenate([v, i.astype(jnp.float32)], axis=1)
+                g = jax.lax.all_gather(buf, axis, axis=1, tiled=True)
+                g = g.reshape(g.shape[0], nsh, 2 * k_local)
+                v_all = g[:, :, :k_local].reshape(-1, nsh * k_local)
+                i_all = g[:, :, k_local:].reshape(
+                    -1, nsh * k_local).astype(jnp.int32)
+            else:
+                v_all = jax.lax.all_gather(v, axis, axis=1, tiled=True)
+                i_all = jax.lax.all_gather(i, axis, axis=1, tiled=True)
+            mv, sel = jax.lax.top_k(v_all, k_out)
+            mi = jnp.take_along_axis(i_all, sel, axis=1)
+            mi = jnp.where(jnp.isfinite(mv), mi, -1)
+            if packed:  # packed result: ONE host pull
+                return jnp.concatenate([mv, mi.astype(jnp.float32)], axis=1)
+            return mv, mi
 
         def run(q, items):
-            v, i = shard_map(
-                local_topk, mesh=self._mesh,
+            return shard_map(
+                local_merge, mesh=self._mesh,
                 in_specs=(P(), P(axis, None)),
-                out_specs=(P(None, axis), P(None, axis)),
-            )(q, items)  # [B, P*k_local] per buffer, sharded over axis
-            # Replicate the candidate sets ONCE before the merge: without
-            # this, the merge's take_along_axis on the sharded index array
-            # lowers as mask + all-reduce (the same GSPMD gather trap the
-            # ALS half-step hit — docs/PERF_NOTES.md "Model-sharded
-            # collectives"). With it, the collective inventory is exactly
-            # the two candidate-sized all-gathers the docstring promises.
-            v = jax.lax.with_sharding_constraint(
-                v, NamedSharding(self._mesh, P()))
-            i = jax.lax.with_sharding_constraint(
-                i, NamedSharding(self._mesh, P()))
-            mv, sel = jax.lax.top_k(v, k_out)
-            mi = jnp.take_along_axis(i, sel, axis=1)
-            mi = jnp.where(jnp.isfinite(mv), mi, -1)
-            if n_total < PACKED_IDX_LIMIT:  # pack: ONE host pull
-                return jnp.concatenate(
-                    [mv, mi.astype(jnp.float32)], axis=1)
-            return mv, mi
+                out_specs=P() if packed else (P(), P()),
+            )(q, items)
 
         return jax.jit(run, in_shardings=(
             NamedSharding(self._mesh, P()),
             NamedSharding(self._mesh, P(axis, None)),
-        ))
+        )).lower(
+            jax.ShapeDtypeStruct((b_pad, self._items.shape[1]), jnp.float32),
+            jax.ShapeDtypeStruct(self._items.shape, jnp.float32),
+        ).compile()
 
     def topk(self, queries, k: int):
         """(values [B, k], indices [B, k]) — indices -1 beyond catalog.
         Accepts [D] or [B, D]; exact parity with DeviceRetriever.topk
         (pinned by test_retrieval.test_sharded_matches_single_device)."""
-        import jax.numpy as jnp
+        import jax
 
         def invoke(qp, k_pad):
             k_local = min(k_pad, self._shard_rows)
-            out = self._call_for(qp.shape[0], k_local, k_pad)(
-                jnp.asarray(qp), self._items)
+            call = self._call_for(qp.shape[0], k_local, k_pad)
+            # padded numpy batch straight into the compiled executable
+            # (an asarray here would land it on the default device first,
+            # just to be resharded by the in_shardings)
+            with _COLLECTIVE_LAUNCH_LOCK:
+                out = jax.block_until_ready(call(qp, self._items))
             return out, self.n_total < PACKED_IDX_LIMIT
 
         return _dispatch_topk(np.asarray(queries, dtype=np.float32),
                               self.n_total, k, invoke)
+
+    def prewarm(self, batch_sizes=(1,), ks=(10,)) -> list[tuple[int, int]]:
+        """AOT-build and PIN the hot serving shapes' executables — same
+        contract as ``DeviceRetriever.prewarm``."""
+        warmed: list[tuple[int, int]] = []
+        for b in batch_sizes:
+            for k in ks:
+                k_eff = min(k, self.n_total)
+                if b <= 0 or k_eff <= 0:
+                    continue
+                b_pad, k_pad = _query_shapes(b, k_eff, self.n_total)
+                if (b_pad, k_pad) in warmed:
+                    continue
+                self._call_for(b_pad, min(k_pad, self._shard_rows), k_pad,
+                               pin=True)
+                warmed.append((b_pad, k_pad))
+        return warmed
 
 
 class RetrievalServingMixin:

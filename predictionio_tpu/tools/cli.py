@@ -12,6 +12,7 @@ console/Console.scala:128-1245). Same verb set, no JVM/spark-submit spawning
   pio eval <Evaluation> [<EngineParamsGenerator>]
   pio deploy [--port 8000] [--feedback] [--event-server-url ...]
   pio batchpredict --input queries.jsonl --output predictions.jsonl
+  pio bench serve [--ways 1,2,4,8]
   pio undeploy [--port 8000]
   pio eventserver [--port 7070] [--stats]
   pio adminserver [--port 7071]
@@ -522,6 +523,33 @@ def _retriever_mesh(n: int):
         _die(str(e))
 
 
+def cmd_bench(args) -> int:
+    """`pio bench serve --ways 1,8`: sharded-serving sweep in a FRESH
+    subprocess — on CPU the virtual device count must be forced via
+    XLA_FLAGS before jax initializes, which this (already-jax-importing)
+    process cannot do for itself."""
+    import subprocess
+
+    ways = [int(w) for w in args.ways.split(",") if w.strip()]
+    if not ways:
+        _die("--ways must name at least one mesh width, e.g. 1,8")
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if env["JAX_PLATFORMS"] == "cpu":
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={max(ways)}"
+        ).strip()
+    repo_root = str(Path(__file__).resolve().parents[2])
+    env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [sys.executable, "-m", "predictionio_tpu.tools.serve_bench",
+           "--ways", ",".join(map(str, ways)),
+           "--batch", str(args.batch), "--k", str(args.k),
+           "--iters", str(args.iters), "--n-items", str(args.n_items),
+           "--rank", str(args.rank)]
+    return subprocess.run(cmd, env=env).returncode
+
+
 def cmd_undeploy(args) -> int:
     import urllib.error
     import urllib.request
@@ -708,7 +736,7 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--batch-window-ms", type=float, default=1.0,
                     help="micro-batch window for concurrent queries "
                          "(0 disables batching)")
-    sp.add_argument("--batch-max", type=int, default=64,
+    sp.add_argument("--batch-max", type=int, default=128,
                     help="max queries per micro-batch")
     sp.add_argument("--batch-inflight", type=int, default=8,
                     help="max micro-batches dispatched concurrently "
@@ -724,10 +752,24 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--output", required=True,
                     help="predictions file (JSONL, query + prediction/error)")
     sp.add_argument("--engine-instance-id")
-    sp.add_argument("--batch-max", type=int, default=64,
+    sp.add_argument("--batch-max", type=int, default=128,
                     help="queries per batched predict call")
     sp.add_argument("--retriever-mesh", type=int, default=0,
                     help="shard the scoring catalog over this many devices")
+
+    sp = sub.add_parser("bench")
+    b_sub = sp.add_subparsers(dest="bench_command", required=True)
+    x = b_sub.add_parser("serve",
+                         help="sharded-serving QPS/p50 sweep across mesh "
+                              "widths (fresh subprocess; CPU devices "
+                              "forced to max(--ways))")
+    x.add_argument("--ways", default="1,2,4,8",
+                   help="comma-separated mesh widths")
+    x.add_argument("--batch", type=int, default=128)
+    x.add_argument("--k", type=int, default=10)
+    x.add_argument("--iters", type=int, default=12)
+    x.add_argument("--n-items", type=int, default=65_536)
+    x.add_argument("--rank", type=int, default=64)
 
     sp = sub.add_parser("undeploy")
     sp.add_argument("--ip", default="localhost")
@@ -777,6 +819,7 @@ COMMANDS = {
     "eval": cmd_eval,
     "deploy": cmd_deploy,
     "batchpredict": cmd_batchpredict,
+    "bench": cmd_bench,
     "undeploy": cmd_undeploy,
     "eventserver": cmd_eventserver,
     "adminserver": cmd_adminserver,

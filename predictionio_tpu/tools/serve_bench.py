@@ -1,0 +1,112 @@
+"""Sharded-serving sweep: QPS/p50 across mesh widths, one process.
+
+``pio bench serve`` (tools/cli.py) runs this in a FRESH subprocess so the
+device count can be forced (on CPU, ``--xla_force_host_platform_device_
+count`` must be set before jax initializes); bench.py's sharded-topk
+section drives the same ``sweep()`` for the committed benchmark.
+
+Each row serves a fixed padded batch through ``ShardedDeviceRetriever``
+after ``prewarm()`` (AOT executables pinned in EXEC_CACHE), so the timed
+loop measures the serving path the engine server actually runs: compiled
+call in, ONE packed host pull out, merge on device. The emitted row
+records ``merge`` (the retriever's merge location contract) and the
+executable-cache hit rate so a regression to recompile-per-call or a
+host-side merge is visible in the numbers, not just the timings.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+__all__ = ["sweep", "format_table", "main", "DEFAULT_WAYS", "DEFAULT_BATCH"]
+
+DEFAULT_WAYS = (1, 2, 4, 8)
+# B=128: per-shard score blocks stay cache-resident where the 1-way
+# [B, n_items] block does not — the regime the r5 inversion hid
+# (docs/PERF_NOTES.md "Closing the sharded-serving inversion")
+DEFAULT_BATCH = 128
+
+
+def sweep(ways=DEFAULT_WAYS, *, n_items: int = 65_536, rank: int = 64,
+          batch: int = DEFAULT_BATCH, k: int = 10, iters: int = 12,
+          seed: int = 7) -> list[dict]:
+    """One row per mesh width: p50 latency + QPS for a batched topk."""
+    import jax
+
+    from ..ops.retrieval import EXEC_CACHE, ShardedDeviceRetriever
+    from ..parallel.mesh import make_mesh
+
+    ndev = len(jax.devices())
+    if ndev < max(ways):
+        raise RuntimeError(
+            f"sweep needs {max(ways)} devices, jax sees {ndev} — on CPU "
+            f"set XLA_FLAGS=--xla_force_host_platform_device_count="
+            f"{max(ways)} before jax initializes (pio bench serve does "
+            f"this for you)")
+
+    rng = np.random.default_rng(seed)
+    items = (rng.normal(size=(n_items, rank)) / np.sqrt(rank)).astype(
+        np.float32)
+    q = (rng.normal(size=(batch, rank)) / np.sqrt(rank)).astype(np.float32)
+
+    rows = []
+    for w in ways:
+        mesh = make_mesh((w,), ("model",))
+        ret = ShardedDeviceRetriever(items, mesh)
+        ret.prewarm(batch_sizes=(batch,), ks=(k,))
+        ret.topk(q, k)  # warm the non-compile parts of the path too
+        lat = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            vals, _ = ret.topk(q, k)
+            np.asarray(vals)  # host fence: time includes the one pull
+            lat.append(time.perf_counter() - t0)
+        lat.sort()
+        p50 = lat[len(lat) // 2]
+        rows.append({
+            "ways": w,
+            "p50_ms": p50 * 1e3,
+            "qps": batch / p50,
+            "merge": ret.merge,
+            "exec_cache_hit_rate": EXEC_CACHE.stats()["hitRate"],
+            "batch": batch,
+            "k": k,
+            "n_items": n_items,
+        })
+    return rows
+
+
+def format_table(rows: list[dict]) -> str:
+    head = f"{'ways':>4}  {'p50_ms':>8}  {'qps':>8}  {'merge':>6}  " \
+           f"{'cache_hit':>9}"
+    lines = [head, "-" * len(head)]
+    for r in rows:
+        lines.append(
+            f"{r['ways']:>4}  {r['p50_ms']:>8.3f}  {r['qps']:>8.0f}  "
+            f"{r['merge']:>6}  {r['exec_cache_hit_rate']:>9.3f}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        description="sharded-serving QPS/p50 sweep across mesh widths")
+    p.add_argument("--ways", default=",".join(map(str, DEFAULT_WAYS)),
+                   help="comma-separated mesh widths, e.g. 1,8")
+    p.add_argument("--batch", type=int, default=DEFAULT_BATCH)
+    p.add_argument("--k", type=int, default=10)
+    p.add_argument("--iters", type=int, default=12)
+    p.add_argument("--n-items", type=int, default=65_536)
+    p.add_argument("--rank", type=int, default=64)
+    args = p.parse_args(argv)
+    ways = tuple(int(w) for w in args.ways.split(",") if w.strip())
+    rows = sweep(ways, n_items=args.n_items, rank=args.rank,
+                 batch=args.batch, k=args.k, iters=args.iters)
+    print(format_table(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -6,6 +6,10 @@ spray/akka actors:
 
 - ``POST /queries.json``  -> serve one query (the hot path, :462-591)
 - ``GET  /``              -> engine status JSON (Twirl HTML page analog)
+- ``GET  /stats.json``    -> serving telemetry: request counters, the
+  micro-batcher's adaptive window + pipeline occupancy, and the shared
+  executable-cache hit/miss/eviction counters (no reference analog —
+  operational surface for the TPU serving path)
 - ``GET  /reload``        -> hot-swap to the latest COMPLETED instance
   (MasterActor's UpgradeActor/ReloadServer, :592-598) — models are
   rehydrated into a fresh ``Deployed`` bundle, then the reference is
@@ -78,6 +82,7 @@ class Deployed:
     result: TrainResult
     retriever_mesh: object = None
     retriever_axis: str = "model"
+    prewarm_batch: int = 0  # pre-compile executables for this batch ceiling
 
     def __post_init__(self):
         # On TPU backends, move catalog factors device-resident so queries
@@ -107,6 +112,28 @@ class Deployed:
                 except Exception:  # pragma: no cover - serving must not die
                     log.exception("device retriever attach failed; "
                                   "serving falls back to host scoring")
+        if self.prewarm_batch > 0:
+            self._prewarm()
+
+    def _prewarm(self):
+        """AOT-compile the hot serving shapes at DEPLOY time so the first
+        real query (and the first full micro-batch) never pays a compile.
+        The micro-batcher produces two hot shapes: a lone query (pad 1)
+        and a full window (pad ``prewarm_batch``); both are pinned in the
+        executable cache (ops/retrieval.py EXEC_CACHE)."""
+        sizes = sorted({1, self.prewarm_batch})
+        for model in self.result.models:
+            for attr in ("_retriever", "_sim_retriever"):
+                r = getattr(model, attr, None)
+                if r is None or not hasattr(r, "prewarm"):
+                    continue
+                try:
+                    warmed = r.prewarm(batch_sizes=sizes)
+                    log.info("prewarmed %s.%s shapes %s",
+                             type(model).__name__, attr, warmed)
+                except Exception:  # pragma: no cover - warming is advisory
+                    log.exception("executable prewarm failed; first "
+                                  "queries will compile on demand")
 
 
 class EngineServer:
@@ -121,7 +148,7 @@ class EngineServer:
         feedback_url: str | None = None,
         access_key: str | None = None,
         batch_window_ms: float = 1.0,
-        batch_max: int = 64,
+        batch_max: int = 128,
         batch_inflight: int = 8,
         engine_dir=None,
         retriever_mesh=None,
@@ -130,10 +157,12 @@ class EngineServer:
         self.engine = engine
         self.ctx = ctx or Context(mode="Serving")
         self.engine_dir = engine_dir  # for re-resolving blob classes
+        self.batch_max = batch_max
         self.deployed = Deployed(
             instance,
             prepare_deploy(engine, instance, self.ctx, engine_dir=engine_dir),
-            retriever_mesh=retriever_mesh, retriever_axis=retriever_axis)
+            retriever_mesh=retriever_mesh, retriever_axis=retriever_axis,
+            prewarm_batch=batch_max)
         self.feedback_url = feedback_url
         self.access_key = access_key
         self.start_time = datetime.now(timezone.utc)
@@ -158,6 +187,9 @@ class EngineServer:
                 self.serve_query_batch,
                 max_batch=batch_max, window_s=batch_window_ms / 1000.0,
                 max_inflight=batch_inflight,
+                adaptive=True,  # window_s becomes the CEILING: idle
+                # servers converge to ~0 added latency, loaded ones
+                # stretch toward a full batch (workflow/microbatch.py)
             )
 
     # -- query hot path ----------------------------------------------------
@@ -257,7 +289,8 @@ class EngineServer:
         fresh = Deployed(latest, prepare_deploy(self.engine, latest, self.ctx,
                                                 engine_dir=self.engine_dir),
                          retriever_mesh=self.deployed.retriever_mesh,
-                         retriever_axis=self.deployed.retriever_axis)
+                         retriever_axis=self.deployed.retriever_axis,
+                         prewarm_batch=self.batch_max)
         self.deployed = fresh  # atomic reference swap
         log.info("Reloaded engine instance %s", latest.id)
         return latest.id
@@ -275,6 +308,24 @@ class EngineServer:
             "lastServingSec": self.last_serving_sec,
             "algorithms": [type(a).__name__ for a in self.deployed.result.algorithms],
             **({"batching": self.batcher.stats()} if self.batcher else {}),
+        }
+
+    def serving_stats(self) -> dict:
+        """Machine-readable serving telemetry (GET /stats.json): request
+        counters, micro-batcher window/occupancy, and the shared
+        executable-cache hit/miss/eviction counters."""
+        from ..ops.retrieval import EXEC_CACHE
+
+        with self._stats_lock:
+            counters = {
+                "requestCount": self.request_count,
+                "avgServingSec": self.avg_serving_sec,
+                "lastServingSec": self.last_serving_sec,
+            }
+        return {
+            **counters,
+            "batching": self.batcher.stats() if self.batcher else None,
+            "execCache": EXEC_CACHE.stats(),
         }
 
     async def send_feedback(self, query_json: dict, prediction: dict, pr_id: str) -> None:
@@ -365,6 +416,10 @@ async def handle_status(request: web.Request) -> web.Response:
     return web.json_response(s)
 
 
+async def handle_stats_json(request: web.Request) -> web.Response:
+    return web.json_response(request.app[SERVER_KEY].serving_stats())
+
+
 async def handle_reload(request: web.Request) -> web.Response:
     server: EngineServer = request.app[SERVER_KEY]
     try:
@@ -388,6 +443,7 @@ def create_engine_server_app(server: EngineServer) -> web.Application:
     app[SERVER_KEY] = server
     app.router.add_post("/queries.json", handle_query)
     app.router.add_get("/", handle_status)
+    app.router.add_get("/stats.json", handle_stats_json)
     app.router.add_get("/reload", handle_reload)
     app.router.add_get("/stop", handle_stop)
 

@@ -15,6 +15,14 @@ into batched serve calls:
 - an idle server adds at most the window to p50; a loaded server turns N
   device calls into ceil(N/max_batch).
 
+With ``adaptive=True`` the window is not fixed: ``window_s`` becomes a
+CEILING and the actual window per batch scales with the observed arrival
+rate (EWMA of inter-arrival gaps) and pipeline occupancy. An idle server
+converges to a ~0 window (a lone query pays wire latency, not the
+ceiling); under load the window stretches toward the time it takes
+``max_batch`` arrivals to accumulate, capped at the ceiling. Arrival
+order is still preserved — only the sleep length changes.
+
 Batches are PIPELINED: up to ``max_inflight`` batches may be dispatched
 concurrently. On the tunneled TPU platform a device call costs ~65 ms of
 dispatch round trip around ~1.3 ms of device time (docs/PERF_NOTES.md),
@@ -35,6 +43,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import Any, Callable, Sequence
 
 log = logging.getLogger("predictionio_tpu.server")
@@ -56,16 +65,22 @@ class MicroBatcher:
         self,
         batch_fn: Callable[[Sequence[Any]], list],
         *,
-        max_batch: int = 64,
+        max_batch: int = 128,
         window_s: float = 0.001,
         max_pending: int = 1024,
         max_inflight: int = 8,
+        adaptive: bool = False,
     ):
         self.batch_fn = batch_fn
         self.max_batch = max(1, max_batch)
         self.window_s = max(0.0, window_s)
         self.max_pending = max(1, max_pending)
         self.max_inflight = max(1, max_inflight)
+        self.adaptive = adaptive
+        # adaptive-window state: EWMA of inter-arrival gaps + last arrival
+        self._ewma_iv: float | None = None
+        self._last_arrival: float | None = None
+        self.last_window_s = 0.0 if adaptive else self.window_s
         self._pending: list[tuple[Any, asyncio.Future]] = []
         self._wake: asyncio.Event | None = None
         self._task: asyncio.Task | None = None
@@ -97,11 +112,41 @@ class MicroBatcher:
             raise ServerBusy(
                 f"micro-batch queue full ({self.max_pending} pending)")
         self._ensure_started()
+        if self.adaptive:
+            self._note_arrival(time.monotonic())
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending.append((query, fut))
         assert self._wake is not None
         self._wake.set()
         return await fut
+
+    def _note_arrival(self, now: float) -> None:
+        if self._last_arrival is not None:
+            # clamp: an idle hour is a gap, not a rate estimate
+            gap = min(now - self._last_arrival, 1.0)
+            self._ewma_iv = (gap if self._ewma_iv is None
+                             else 0.7 * self._ewma_iv + 0.3 * gap)
+        self._last_arrival = now
+
+    def _choose_window(self, now: float) -> float:
+        """Window for the batch about to form: 0 when waiting can't help
+        (batch already full, no rate history, or arrivals slower than the
+        ceiling with pipeline slots free), else the time ``need`` more
+        arrivals are expected to take, capped at the ``window_s`` ceiling."""
+        if not self.adaptive:
+            return self.window_s
+        need = self.max_batch - len(self._pending)
+        if need <= 0 or self._ewma_iv is None:
+            return 0.0
+        iv = self._ewma_iv
+        if self._last_arrival is not None:
+            # a fresh idle gap overrides a stale burst-rate estimate
+            iv = max(iv, now - self._last_arrival)
+        if iv >= self.window_s and self._live < self.max_inflight:
+            # a window can't fill a batch at this rate; with the pipeline
+            # saturated waiting is free, otherwise dispatch now
+            return 0.0
+        return min(self.window_s, need * iv)
 
     async def close(self) -> None:
         self._closing = True  # submit() sheds until the drain finishes
@@ -134,9 +179,11 @@ class MicroBatcher:
         assert self._wake is not None and self._sem is not None
         while True:
             await self._wake.wait()
-            if self.window_s > 0 and len(self._pending) < self.max_batch:
+            w = self._choose_window(time.monotonic())
+            self.last_window_s = w
+            if w > 0 and len(self._pending) < self.max_batch:
                 # window open: let concurrent requests pile in
-                await asyncio.sleep(self.window_s)
+                await asyncio.sleep(w)
             # bound in-flight BEFORE taking queries off the queue, so a
             # saturated pipeline backpressures into max_pending/503 land
             # instead of stripping the queue into waiting tasks
@@ -196,4 +243,11 @@ class MicroBatcher:
             "maxBatchSize": self.max_seen_batch,
             "maxInflight": self.max_inflight,
             "peakInflight": self.peak_inflight,
+            "adaptive": self.adaptive,
+            "windowCeilingMs": self.window_s * 1e3,
+            "lastWindowMs": self.last_window_s * 1e3,
+            "inflight": self._live,
+            "occupancy": self._live / self.max_inflight,
+            "arrivalIntervalMs": (self._ewma_iv * 1e3
+                                  if self._ewma_iv is not None else None),
         }

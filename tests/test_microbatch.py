@@ -241,6 +241,81 @@ class TestMicroBatcher:
         assert run(main()) == 7
 
 
+class TestAdaptiveWindow:
+    """adaptive=True: window_s becomes a ceiling scaled by arrival rate.
+    The policy itself is exercised with synthetic clocks (no sleeps), the
+    integration tests only assert the coarse ends of the behavior."""
+
+    def _mb(self, **kw):
+        return MicroBatcher(lambda qs: [("ok", q) for q in qs],
+                            adaptive=True, **kw)
+
+    def test_no_history_dispatches_immediately(self):
+        mb = self._mb(window_s=5.0)
+        assert mb._choose_window(100.0) == 0.0  # no EWMA yet -> no wait
+
+    def test_fast_arrivals_open_a_bounded_window(self):
+        mb = self._mb(window_s=5.0, max_batch=64)
+        t = 100.0
+        for _ in range(20):  # ~1 kHz arrival stream
+            mb._note_arrival(t)
+            t += 0.001
+        mb._pending = [(0, None)] * 4  # 60 more needed for a full batch
+        w = mb._choose_window(t)
+        assert 0 < w <= mb.window_s
+        assert w >= 0.004  # at ~1 ms gaps, 60 needed -> well above 4 ms
+
+    def test_stale_rate_overridden_by_fresh_idle_gap(self):
+        mb = self._mb(window_s=0.05, max_batch=64)
+        t = 100.0
+        for _ in range(20):
+            mb._note_arrival(t)
+            t += 0.001
+        # 10 s of silence: the burst-era EWMA must not hold a lone query
+        assert mb._choose_window(t + 10.0) == 0.0
+
+    def test_full_batch_never_waits(self):
+        mb = self._mb(window_s=5.0, max_batch=4)
+        t = 100.0
+        for _ in range(8):
+            mb._note_arrival(t)
+            t += 0.001
+        mb._pending = [(i, None) for i in range(4)]
+        assert mb._choose_window(t) == 0.0
+
+    def test_lone_query_not_held_to_ceiling(self):
+        """End to end: with a 5 s ceiling, an idle adaptive batcher must
+        answer a lone query in wire time, not ceiling time."""
+        import time
+
+        async def main():
+            mb = self._mb(window_s=5.0)
+            t0 = time.perf_counter()
+            out = await mb.submit(42)
+            dt = time.perf_counter() - t0
+            await mb.close()
+            return out, dt
+
+        out, dt = run(main())
+        assert out == 42
+        assert dt < 1.0, f"idle adaptive batcher paid the ceiling ({dt:.2f}s)"
+
+    def test_burst_preserves_submit_order(self):
+        async def main():
+            mb = self._mb(window_s=0.01, max_batch=8)
+            out = await asyncio.gather(*[mb.submit(i) for i in range(32)])
+            s = mb.stats()
+            await mb.close()
+            return out, s
+
+        out, s = run(main())
+        assert out == list(range(32))
+        assert s["adaptive"] is True
+        assert s["windowCeilingMs"] == pytest.approx(10.0)
+        assert "lastWindowMs" in s and "occupancy" in s
+        assert s["inflight"] == 0  # drained
+
+
 class TestBatchedServing:
     """serve_query_batch against the real recommendation template."""
 
@@ -314,6 +389,24 @@ class TestBatchedServing:
         server, _mod = served
         out = server.serve_query_batch([{"user": "u1", "num": -1}])
         assert out[0][0] == "ok" and out[0][1]["itemScores"] == []
+
+    def test_stats_json_surface(self, served):
+        """GET /stats.json telemetry: request counters, the adaptive
+        micro-batcher fields, and the shared executable-cache counters."""
+        from predictionio_tpu.workflow.create_server import (
+            create_engine_server_app)
+
+        server, _mod = served
+        server.serve_query({"user": "u1", "num": 2})
+        s = server.serving_stats()
+        assert s["requestCount"] >= 1
+        assert s["batching"]["adaptive"] is True
+        assert {"hits", "misses", "evictions", "hitRate"} <= \
+            set(s["execCache"])
+        app = create_engine_server_app(server)
+        assert any(r.resource is not None
+                   and r.resource.canonical == "/stats.json"
+                   for r in app.router.routes())
 
     def test_close_fails_pending(self):
         import threading

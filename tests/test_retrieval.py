@@ -154,14 +154,12 @@ def test_sharded_collective_inventory(rng):
     Mirrors test_als.test_model_sharded_collective_inventory."""
     import re
 
-    import jax.numpy as jnp
-
     items = rng.standard_normal((4096, 32)).astype(np.float32)
     ret = _sharded(items)
     b_pad, k_pad = 8, 16
-    fn = ret._call_for(b_pad, k_pad, k_pad)
-    q = jnp.zeros((b_pad, 128), jnp.float32)
-    hlo = fn.lower(q, ret._items).compile().as_text()
+    # _call_for now returns an AOT-compiled executable (the serving path
+    # never traces at request time), so the HLO comes straight off it
+    hlo = ret._call_for(b_pad, k_pad, k_pad).as_text()
     assert not re.search(r"all-reduce(?!-scatter)", hlo), "unexpected all-reduce"
     assert "all-to-all" not in hlo, "unexpected all-to-all"
     assert "reduce-scatter" not in hlo, "unexpected reduce-scatter"
@@ -171,6 +169,104 @@ def test_sharded_collective_inventory(rng):
         size = np.prod([int(x) for x in dims.split(",")])
         assert size <= 8 * b_pad * 2 * k_pad * 4, (
             f"all-gather of {dims} exceeds candidate-set scale")
+
+
+@pytest.mark.parametrize("width", [1, 2, 4, 8])
+def test_sharded_bitwise_parity(rng, width):
+    """On-device merge parity is BITWISE, not approximate: every mesh
+    width must return byte-identical values AND indices to the
+    single-device retriever — including on exact score ties (duplicated
+    catalog rows) and all-zero scores (a zero query ties the whole
+    catalog), where the tie-break order is the contract. Works because
+    the tiled all-gather is shard-major (candidates in ascending global
+    index order) and top_k breaks ties by lowest index on both paths."""
+    N, D, k = 1536, 24, 10
+    base = rng.standard_normal((N - 64, D)).astype(np.float32)
+    items = np.concatenate([base, base[:64]], axis=0)  # exact dup rows
+    q = rng.standard_normal((5, D)).astype(np.float32)
+    q[0] = 0.0  # full-catalog tie
+    want_v, want_i = DeviceRetriever(items).topk(q, k)
+    ret = _sharded(items, axis_len=width)
+    assert ret.merge == "device"
+    vals, idx = ret.topk(q, k)
+    assert np.array_equal(vals, want_v)
+    assert np.array_equal(idx, want_i)
+
+
+class TestExecutableCache:
+    def _cache(self, **kw):
+        from predictionio_tpu.ops.retrieval import ExecutableCache
+
+        return ExecutableCache(**kw)
+
+    def test_hit_miss_counters(self):
+        c = self._cache()
+        built = []
+        for _ in range(3):
+            c.get_or_build("a", lambda: built.append(1) or "exe")
+        assert built == [1]  # built once, then served from cache
+        s = c.stats()
+        assert s["misses"] == 1 and s["hits"] == 2
+        assert s["hitRate"] == pytest.approx(2 / 3)
+
+    def test_eviction_is_lru(self):
+        c = self._cache(maxsize=2)
+        c.get_or_build("a", lambda: "A")
+        c.get_or_build("b", lambda: "B")
+        c.get_or_build("a", lambda: "A")  # refresh a: b is now oldest
+        c.get_or_build("c", lambda: "C")  # evicts b
+        assert c.stats()["evictions"] == 1
+        rebuilt = []
+        c.get_or_build("a", lambda: rebuilt.append("a") or "A")
+        c.get_or_build("b", lambda: rebuilt.append("b") or "B")
+        assert rebuilt == ["b"]  # a survived, b was the victim
+
+    def test_pinned_never_evicted(self):
+        c = self._cache(maxsize=2)
+        c.get_or_build("hot", lambda: "H")
+        c.pin("hot")
+        for key in "abcdef":
+            c.get_or_build(key, lambda: key.upper())
+        rebuilt = []
+        c.get_or_build("hot", lambda: rebuilt.append(1) or "H")
+        assert rebuilt == []  # survived every eviction round
+        assert c.stats()["pinned"] == 1
+
+
+@pytest.mark.parametrize("make", [
+    pytest.param(lambda items: DeviceRetriever(items), id="single"),
+    pytest.param(lambda items: _sharded(items), id="sharded"),
+])
+def test_prewarm_precompiles_serving_shapes(rng, make):
+    """A serving call whose padded shape was prewarmed must be a pure
+    cache HIT — zero compiles at request time (the AOT deploy-time
+    warming create_server.py does with prewarm_batch)."""
+    from predictionio_tpu.ops.retrieval import EXEC_CACHE
+
+    items = rng.standard_normal((600, 16)).astype(np.float32)
+    ret = make(items)
+    warmed = ret.prewarm(batch_sizes=(1, 32), ks=(10,))
+    assert warmed  # at least one (b_pad, k_pad) compiled
+    before = EXEC_CACHE.stats()
+    ret.topk(rng.standard_normal((32, 16)).astype(np.float32), 10)
+    ret.topk(rng.standard_normal(16).astype(np.float32), 10)
+    after = EXEC_CACHE.stats()
+    assert after["misses"] == before["misses"]
+    assert after["hits"] >= before["hits"] + 2
+
+
+def test_serve_bench_sweep_smoke(rng):
+    """tools/serve_bench.sweep in-process at tiny scale: rows carry the
+    merge-location and cache-hit-rate fields the bench config records."""
+    from predictionio_tpu.tools.serve_bench import format_table, sweep
+
+    rows = sweep((1, 2), n_items=512, rank=8, batch=8, k=5, iters=2)
+    assert [r["ways"] for r in rows] == [1, 2]
+    for r in rows:
+        assert r["merge"] == "device"
+        assert r["exec_cache_hit_rate"] > 0
+        assert r["p50_ms"] > 0 and r["qps"] > 0
+    assert "device" in format_table(rows)
 
 
 def test_sharded_mixin_swaps_in(rng):
