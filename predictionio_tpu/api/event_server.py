@@ -39,6 +39,7 @@ from ..storage import (
 )
 from ..storage.event import _dt_from_wire
 from ..storage.events_base import StorageError
+from ..workflow.faults import FAULTS
 from .stats import Stats
 from .webhooks import ConnectorException, FormConnector, JsonConnector, get_connector
 
@@ -147,6 +148,9 @@ async def _insert_one(
     here mirrors the reference's (each POST is one event record)."""
     events = Storage.get_events()
     try:
+        # chaos site: arm a StorageError here to exercise the real
+        # 500/stats path without a broken backend (workflow/faults.py)
+        await FAULTS.afire("eventserver.insert")
         event_id = await asyncio.to_thread(
             events.insert, event, auth.app_id, auth.channel_id
         )

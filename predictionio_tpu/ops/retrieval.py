@@ -28,6 +28,8 @@ import threading
 
 import numpy as np
 
+from ..workflow.faults import FAULTS
+
 __all__ = ["topk_scores", "DeviceRetriever", "ShardedDeviceRetriever",
            "RetrievalServingMixin", "row_normalize", "ExecutableCache",
            "EXEC_CACHE"]
@@ -416,6 +418,8 @@ def _dispatch_topk(q: np.ndarray, n_total: int, k: int, invoke):
     drift between them. ``invoke(q_padded, k_pad)`` runs the compiled
     call and returns either a (vals, idx) tuple or the packed
     [B, 2*k_pad] f32 buffer (detected here by type)."""
+    FAULTS.fire("retrieval.topk")  # chaos site: a hang here IS a hung
+    # device call (workflow/faults.py); no-op unless a test armed it
     single = q.ndim == 1
     if single:
         q = q[None, :]

@@ -440,6 +440,9 @@ def cmd_deploy(args) -> int:
         batch_window_ms=args.batch_window_ms,
         batch_max=args.batch_max,
         batch_inflight=args.batch_inflight,
+        deadline_ms=args.deadline_ms,
+        dispatch_timeout_s=args.dispatch_timeout_s,
+        degraded_cooldown_s=args.degraded_cooldown_s,
         engine_dir=engine_dir,
         retriever_mesh=_retriever_mesh(args.retriever_mesh),
     )
@@ -744,6 +747,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--retriever-mesh", type=int, default=0,
                     help="shard the serving catalog over this many devices "
                          "(model axis; 0/1 = single-device catalog)")
+    sp.add_argument("--deadline-ms", type=float, default=0.0,
+                    help="default end-to-end deadline per query in ms "
+                         "(expired queries answer 504; 0 disables; the "
+                         "X-PIO-Deadline-Ms request header can tighten it)")
+    sp.add_argument("--dispatch-timeout-s", type=float, default=30.0,
+                    help="stuck-dispatch watchdog: a batch dispatch "
+                         "exceeding this reclaims its pipeline slot and "
+                         "flips the server degraded (0 disables)")
+    sp.add_argument("--degraded-cooldown-s", type=float, default=15.0,
+                    help="seconds between half-open probe batches while "
+                         "the server is degraded")
 
     sp = sub.add_parser("batchpredict")
     _add_engine_args(sp)

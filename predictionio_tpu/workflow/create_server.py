@@ -15,9 +15,26 @@ spray/akka actors:
   rehydrated into a fresh ``Deployed`` bundle, then the reference is
   swapped atomically (double-buffering; on-device factor arrays from the
   old bundle are dropped after the swap).
-- ``GET  /stop``          -> graceful shutdown (:600-608)
+- ``GET  /health.json``   -> liveness/readiness for load balancers:
+  deployed-bundle state, degraded mode, watchdog trips, drain status
+  (503 while draining so an LB rotates the instance out before exit)
+- ``GET  /stop``          -> graceful shutdown (:600-608); drains first
 - feedback loop: when enabled, every query/prediction pair is POSTed to
-  the event server with prId threading (:488-541).
+  the event server with prId threading (:488-541) through a lifecycle-
+  owned publisher (workflow/feedback.py): one shared ClientSession,
+  tracked tasks, bounded retries, circuit breaker.
+
+Resilience (no reference analog — the akka stack got this from actor
+supervision + spray timeouts): requests carry end-to-end deadlines
+(``--deadline-ms`` or the ``X-PIO-Deadline-Ms`` header; expiry answers
+504 without consuming a batch slot), every dispatched batch runs under a
+stuck-dispatch watchdog that reclaims its pipeline slot instead of
+wedging it, and a watchdog trip flips the server DEGRADED: queries
+bypass the batcher onto a per-query fallback path, the pipeline shrinks,
+and a half-open probe per cooldown window decides when to resume
+batching. SIGTERM and ``/stop`` perform a graceful drain (stop
+accepting, flush the queue, finish in-flight batches, close the
+feedback loop) before exit.
 
 Queries are parsed with the algorithm's ``query_class`` dataclass when
 declared (the reference's per-algorithm querySerializer), else passed as
@@ -42,7 +59,9 @@ from aiohttp import web
 from ..controller.engine import Engine, TrainResult
 from ..controller.params import parse_params
 from ..storage import EngineInstance, Storage
-from .microbatch import ServerBusy
+from .faults import FAULTS
+from .feedback import FeedbackPublisher
+from .microbatch import DeadlineExceeded, DispatchTimeout, ServerBusy
 from .context import Context
 from .core_workflow import prepare_deploy
 
@@ -150,6 +169,9 @@ class EngineServer:
         batch_window_ms: float = 1.0,
         batch_max: int = 128,
         batch_inflight: int = 8,
+        deadline_ms: float = 0.0,
+        dispatch_timeout_s: float | None = 30.0,
+        degraded_cooldown_s: float = 15.0,
         engine_dir=None,
         retriever_mesh=None,
         retriever_axis: str = "model",
@@ -165,6 +187,10 @@ class EngineServer:
             prewarm_batch=batch_max)
         self.feedback_url = feedback_url
         self.access_key = access_key
+        # lifecycle-owned feedback publisher: one shared session, tracked
+        # tasks, bounded retry queue, circuit breaker (workflow/feedback.py)
+        self.feedback = (FeedbackPublisher(feedback_url, access_key)
+                         if feedback_url and access_key else None)
         self.start_time = datetime.now(timezone.utc)
         # bookkeeping (CreateServer.scala:396-398)
         self.request_count = 0
@@ -176,6 +202,18 @@ class EngineServer:
         # a single actor, CreateServer.scala:552-559)
         self._stats_lock = threading.Lock()
         self._reload_lock = threading.Lock()  # serialize expensive reloads
+        # resilience state: deadlines, degraded mode, drain
+        self.deadline_ms = max(0.0, deadline_ms)
+        self.dispatch_timeout_s = (dispatch_timeout_s
+                                   if dispatch_timeout_s and
+                                   dispatch_timeout_s > 0 else None)
+        self.degraded_cooldown_s = max(0.1, degraded_cooldown_s)
+        self.degraded = False
+        self.degraded_since: str | None = None
+        self._probe_at: float | None = None  # next half-open probe instant
+        self._inflight_configured = max(1, batch_inflight)
+        self._draining = False
+        self._drained = False
         # micro-batching dispatcher (workflow/microbatch.py): coalesce
         # concurrent queries into fixed-shape batched device calls;
         # window <= 0 disables (per-query dispatch, reference behavior)
@@ -190,7 +228,159 @@ class EngineServer:
                 adaptive=True,  # window_s becomes the CEILING: idle
                 # servers converge to ~0 added latency, loaded ones
                 # stretch toward a full batch (workflow/microbatch.py)
+                dispatch_timeout_s=self.dispatch_timeout_s,
+                on_watchdog=self._on_watchdog_trip,
             )
+
+    # -- resilience: degraded mode, deadlines, drain -----------------------
+    def _on_watchdog_trip(self) -> None:
+        """Runs on the event loop after each stuck-dispatch watchdog trip
+        (microbatch.MicroBatcher.on_watchdog): enter degraded mode —
+        queries bypass the batcher onto the per-query fallback path and
+        the dispatch pipeline shrinks (hung calls mean device distress;
+        piling more concurrency onto it digs the hole deeper). A
+        half-open probe per cooldown window decides when to resume."""
+        if not self.degraded:
+            self.degraded = True
+            self.degraded_since = datetime.now(timezone.utc).isoformat()
+            if self.batcher is not None:
+                self.batcher.set_max_inflight(
+                    max(1, self.batcher.max_inflight // 2))
+            log.error(
+                "entering DEGRADED mode: per-query fallback serving, "
+                "max_inflight shrunk to %d; probe in %.1fs",
+                self.batcher.max_inflight if self.batcher else 0,
+                self.degraded_cooldown_s)
+        self._probe_at = time.monotonic() + self.degraded_cooldown_s
+
+    def _exit_degraded(self) -> None:
+        log.info("leaving degraded mode (probe batch succeeded); "
+                 "max_inflight restored to %d", self._inflight_configured)
+        self.degraded = False
+        self.degraded_since = None
+        self._probe_at = None
+        if self.batcher is not None:
+            self.batcher.set_max_inflight(self._inflight_configured)
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def request_deadline(self, request) -> float | None:
+        """Absolute monotonic deadline for one request: the client's
+        ``X-PIO-Deadline-Ms`` header when present (a tighter client
+        budget wins), else the server's ``--deadline-ms`` default; None
+        when neither is set."""
+        ms = self.deadline_ms
+        hdr = request.headers.get("X-PIO-Deadline-Ms")
+        if hdr is not None:
+            try:
+                client_ms = float(hdr)
+                if client_ms > 0:
+                    ms = min(ms, client_ms) if ms > 0 else client_ms
+            except ValueError:
+                pass  # malformed header: fall back to the server default
+        return time.monotonic() + ms / 1e3 if ms > 0 else None
+
+    async def dispatch_query(self, query_json: dict,
+                             deadline: float | None = None):
+        """The one query entry for the HTTP layer: batched path when
+        healthy, per-query fallback when degraded (with one half-open
+        probe through the batcher per cooldown window), fallback also
+        when batching is disabled."""
+        if self.batcher is None:
+            return await self._fallback_query(query_json, deadline)
+        if self.degraded:
+            now = time.monotonic()
+            if self._probe_at is not None and now >= self._probe_at:
+                # half-open probe: push the cooldown forward FIRST so
+                # concurrent queries keep falling back while this one
+                # tests the batched path
+                self._probe_at = now + self.degraded_cooldown_s
+                result = await self.batcher.submit(query_json,
+                                                   deadline=deadline)
+                # a tripped probe raises DispatchTimeout out of submit()
+                # (another watchdog trip re-arms the cooldown); reaching
+                # here means the batched path is healthy again
+                self._exit_degraded()
+                return result
+            return await self._fallback_query(query_json, deadline)
+        return await self.batcher.submit(query_json, deadline=deadline)
+
+    async def _fallback_query(self, query_json: dict,
+                              deadline: float | None):
+        """Per-query serving off the batcher (degraded mode or batching
+        disabled), still bounded: the watchdog timeout and the request
+        deadline both apply, whichever is tighter."""
+        timeout = self.dispatch_timeout_s
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise DeadlineExceeded("request deadline expired")
+            timeout = min(timeout, remaining) if timeout else remaining
+        work = asyncio.to_thread(self.serve_query, query_json)
+        if timeout is None:
+            return await work
+        try:
+            return await asyncio.wait_for(work, timeout)
+        except asyncio.TimeoutError:
+            if deadline is not None and time.monotonic() >= deadline:
+                raise DeadlineExceeded(
+                    "request deadline expired during serving") from None
+            raise DispatchTimeout(
+                f"per-query serve exceeded {timeout:.1f}s watchdog"
+            ) from None
+
+    async def drain(self) -> None:
+        """Graceful drain (SIGTERM / /stop / app shutdown): stop
+        accepting queries (handle_query 503s), flush the micro-batch
+        queue, finish in-flight batches, close the feedback loop.
+        Idempotent — /stop and the app-shutdown hook may both call it."""
+        if self._draining:
+            return
+        self._draining = True
+        log.info("drain: stopped accepting; flushing micro-batch queue")
+        if self.batcher is not None:
+            await self.batcher.drain()
+        if self.feedback is not None:
+            await self.feedback.aclose()
+        self._drained = True
+        log.info("drain complete (served %d request(s) lifetime)",
+                 self.request_count)
+
+    def undrain(self) -> None:
+        """Re-arm after a drain that did NOT end the process: a failed
+        bind tears the app down (running the drain hook) before
+        run_engine_server retries, and the retry must serve again."""
+        self._draining = False
+        self._drained = False
+        if self.feedback is not None:
+            self.feedback.reopen()
+
+    def health(self) -> dict:
+        """GET /health.json body: liveness + readiness + why. Load
+        balancers key on the HTTP status (503 while draining); humans and
+        autoscalers get the degraded/watchdog/drain detail."""
+        inst = self.deployed.instance
+        b = self.batcher
+        return {
+            "status": ("draining" if self._draining
+                       else "degraded" if self.degraded else "ok"),
+            "live": True,
+            "ready": not self._draining,
+            "engineInstanceId": inst.id,
+            "startTime": self.start_time.isoformat(),
+            "degraded": {
+                "active": self.degraded,
+                "since": self.degraded_since,
+                "watchdogTrips": b.watchdog_trips if b else 0,
+                "zombieDispatches": b.stats()["zombieDispatches"] if b else 0,
+                "maxInflight": b.max_inflight if b else None,
+                "dispatchTimeoutS": self.dispatch_timeout_s,
+            },
+            "drain": {"active": self._draining, "complete": self._drained},
+            "feedback": self.feedback.stats() if self.feedback else None,
+        }
 
     # -- query hot path ----------------------------------------------------
     @staticmethod
@@ -218,6 +408,7 @@ class EngineServer:
         ``batch_predict`` (retrieval models override it with one fused
         device call); serving blends per query as usual.
         """
+        FAULTS.fire("server.serve_batch")
         t0 = time.perf_counter()
         bundle = self.deployed  # snapshot reference (atomic swap safety)
         result = bundle.result
@@ -326,32 +517,17 @@ class EngineServer:
             **counters,
             "batching": self.batcher.stats() if self.batcher else None,
             "execCache": EXEC_CACHE.stats(),
+            "resilience": {
+                "degraded": self.degraded,
+                "degradedSince": self.degraded_since,
+                "watchdogTrips": (self.batcher.watchdog_trips
+                                  if self.batcher else 0),
+                "deadlineExpired": (self.batcher.deadline_expired
+                                    if self.batcher else 0),
+                "draining": self._draining,
+            },
+            "feedback": self.feedback.stats() if self.feedback else None,
         }
-
-    async def send_feedback(self, query_json: dict, prediction: dict, pr_id: str) -> None:
-        """POST the (query, prediction) pair back to the event server
-        (CreateServer.scala:524-530)."""
-        if not self.feedback_url or not self.access_key:
-            return
-        import aiohttp
-
-        event = {
-            "event": "predict",
-            "entityType": "pio_pr",
-            "entityId": pr_id,
-            "properties": {"query": query_json, "prediction": prediction},
-            "prId": pr_id,
-        }
-        try:
-            async with aiohttp.ClientSession() as session:
-                await session.post(
-                    f"{self.feedback_url}/events.json",
-                    params={"accessKey": self.access_key},
-                    json=event,
-                    timeout=aiohttp.ClientTimeout(total=5),
-                )
-        except Exception as e:  # feedback is best-effort (reference logs only)
-            log.warning("feedback POST failed: %s", e)
 
 
 SERVER_KEY = web.AppKey("engine_server", EngineServer)
@@ -359,6 +535,10 @@ SERVER_KEY = web.AppKey("engine_server", EngineServer)
 
 async def handle_query(request: web.Request) -> web.Response:
     server: EngineServer = request.app[SERVER_KEY]
+    if server.draining:
+        return web.json_response(
+            {"message": "Server is draining; not accepting queries."},
+            status=503)
     try:
         query_json = await request.json()
     except (json.JSONDecodeError, UnicodeDecodeError):
@@ -366,19 +546,21 @@ async def handle_query(request: web.Request) -> web.Response:
     if not isinstance(query_json, dict):
         return web.json_response({"message": "Query must be a JSON object."}, status=400)
     try:
-        if server.batcher is not None:
-            result = await server.batcher.submit(query_json)
-        else:
-            result = await asyncio.to_thread(server.serve_query, query_json)
+        result = await server.dispatch_query(
+            query_json, deadline=server.request_deadline(request))
+    except DeadlineExceeded as e:
+        return web.json_response({"message": str(e)}, status=504)
+    except DispatchTimeout as e:
+        return web.json_response({"message": str(e)}, status=504)
     except ServerBusy as e:
         return web.json_response({"message": str(e)}, status=503)
     except Exception as e:  # noqa: BLE001 — surface as 400 like the reference
         log.exception("query failed")
         return web.json_response({"message": str(e)}, status=400)
-    if server.feedback_url:
+    if server.feedback is not None:
         pr_id = uuid.uuid4().hex
         result_with_pr = {**result, "prId": pr_id} if isinstance(result, dict) else result
-        asyncio.create_task(server.send_feedback(query_json, result, pr_id))
+        server.feedback.publish(query_json, result, pr_id)
         return web.json_response(result_with_pr)
     return web.json_response(result)
 
@@ -429,9 +611,26 @@ async def handle_reload(request: web.Request) -> web.Response:
     return web.json_response({"message": "Reloaded", "engineInstanceId": iid})
 
 
+async def handle_health(request: web.Request) -> web.Response:
+    """Liveness/readiness. 200 while serving (even degraded — the
+    instance still answers queries on the fallback path), 503 while
+    draining so a load balancer rotates it out before exit."""
+    server: EngineServer = request.app[SERVER_KEY]
+    body = server.health()
+    return web.json_response(body, status=503 if server.draining else 200)
+
+
 async def handle_stop(request: web.Request) -> web.Response:
+    server: EngineServer = request.app[SERVER_KEY]
+
     async def _stop():
-        await asyncio.sleep(0.1)
+        # drain BEFORE GracefulExit: stop accepting, flush the queue,
+        # finish in-flight batches, close the feedback loop — then let
+        # run_app tear the listener down
+        try:
+            await server.drain()
+        except Exception:  # noqa: BLE001 — exit regardless
+            log.exception("drain failed during /stop; exiting anyway")
         raise web.GracefulExit()
 
     asyncio.create_task(_stop())
@@ -444,16 +643,24 @@ def create_engine_server_app(server: EngineServer) -> web.Application:
     app.router.add_post("/queries.json", handle_query)
     app.router.add_get("/", handle_status)
     app.router.add_get("/stats.json", handle_stats_json)
+    app.router.add_get("/health.json", handle_health)
     app.router.add_get("/reload", handle_reload)
     app.router.add_get("/stop", handle_stop)
 
+    async def _drain_server(app):
+        # graceful drain on ANY teardown (SIGTERM -> run_app's
+        # GracefulExit, /stop, test cleanup): flush queued queries,
+        # finish in-flight batches, close the feedback session.
+        # server.drain() is idempotent — /stop may already have run it.
+        await server.drain()
+
     async def _close_batcher(app):
-        # drain + stop the micro-batch dispatcher on shutdown so pending
-        # batched futures resolve instead of leaking when /stop (or any
-        # app teardown) fires; MicroBatcher.close() is idempotent
+        # after drain, stop the dispatcher loop so nothing leaks; any
+        # future still pending at this point gets CancelledError
         if server.batcher is not None:
             await server.batcher.close()
 
+    app.on_shutdown.append(_drain_server)
     app.on_cleanup.append(_close_batcher)
     return app
 
@@ -522,6 +729,9 @@ def run_engine_server(
             if e.errno != errno.EADDRINUSE:
                 raise
             if attempt < bind_retries:
+                # the failed app already ran its shutdown hooks (drain);
+                # re-arm so the retry actually serves
+                server.undrain()
                 log.error("Bind to %s:%d failed (address in use). "
                           "Retrying... (%d more trial(s))",
                           ip, port, bind_retries - attempt)

@@ -1,0 +1,199 @@
+"""Deterministic fault injection for the serving path (chaos harness).
+
+The resilience layer (request deadlines, the stuck-dispatch watchdog,
+degraded mode, graceful drain, the feedback circuit breaker) only earns
+its keep if every recovery path can be PROVEN to fire. Real hangs are
+not reproducible in CI, so the serving path carries named injection
+sites — `FAULTS.fire("microbatch.dispatch")` and friends — that are
+zero-cost no-ops until a test arms them (the akka analog is a
+supervision-strategy test kit; TensorFlow's nonfatal-failure design,
+arXiv:1605.08695 §4.2, bakes the same idea into its runtime).
+
+Sites instrumented in this repo:
+
+- ``microbatch.dispatch``   — inside the dispatch worker thread, before
+  ``batch_fn`` runs (a hang here is a hung device call holding one of
+  ``max_inflight`` pipeline slots)
+- ``retrieval.topk``        — the shared top-k entry every retriever
+  funnels through (``ops/retrieval._dispatch_topk``)
+- ``server.serve_batch``    — head of ``EngineServer.serve_query_batch``
+- ``server.feedback``       — before each feedback POST leaves the
+  ``FeedbackPublisher`` (async site)
+- ``eventserver.insert``    — inside the event-store write path of
+  ``POST /events.json`` (async site; arm a ``StorageError`` to exercise
+  the 500/stats path without a broken backend)
+
+A fault is armed per site with a kind:
+
+- ``error``  — raise ``exc`` (default ``FaultInjected``)
+- ``slow``   — sleep ``delay_s`` then continue
+- ``hang``   — block on a per-site release event, capped at
+  ``max_hang_s`` so an un-released hang can never wedge a test past its
+  budget; ``release()`` (or ``clear()``) unblocks stuck threads
+
+``times`` bounds how often the fault fires (then it disarms itself), so
+a test can hang exactly ``max_inflight`` dispatches and let recovery
+traffic through. ``fired(site)`` counts actual firings for assertions.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+
+__all__ = ["FaultInjected", "FaultSpec", "FaultInjector", "FAULTS"]
+
+
+class FaultInjected(RuntimeError):
+    """The default exception an armed ``error`` fault raises."""
+
+
+class FaultSpec:
+    """One armed fault: kind + budget + its release latch."""
+
+    __slots__ = ("kind", "exc", "delay_s", "max_hang_s", "times", "release_event")
+
+    def __init__(self, kind: str, *, exc: BaseException | None = None,
+                 delay_s: float = 0.05, max_hang_s: float = 30.0,
+                 times: int | None = None):
+        if kind not in ("error", "slow", "hang"):
+            raise ValueError(f"unknown fault kind {kind!r}")
+        self.kind = kind
+        self.exc = exc
+        self.delay_s = delay_s
+        self.max_hang_s = max_hang_s
+        self.times = times  # None = every call until cleared
+        self.release_event = threading.Event() if kind == "hang" else None
+
+
+class FaultInjector:
+    """Thread-safe registry of armed faults, keyed by site name.
+
+    The un-armed fast path is one attribute read (`_armed` empty-dict
+    truthiness) — serving pays nothing when chaos is off.
+    """
+
+    def __init__(self):
+        self._armed: dict[str, FaultSpec] = {}
+        self._fired: dict[str, int] = {}
+        # hang events with a thread (possibly) blocked on them — kept
+        # separately from _armed so a times-bounded hang that disarmed
+        # itself can still be released by clear()/release()
+        self._hanging: dict[str, list[threading.Event]] = {}
+        self._lock = threading.Lock()
+
+    # -- arming ------------------------------------------------------------
+    def inject(self, site: str, kind: str = "error", **kw) -> FaultSpec:
+        """Arm ``kind`` at ``site``; returns the spec (its
+        ``release_event`` unblocks a ``hang``)."""
+        spec = FaultSpec(kind, **kw)
+        with self._lock:
+            self._armed[site] = spec
+        return spec
+
+    def clear(self, site: str | None = None) -> None:
+        """Disarm one site (or all), releasing any threads hung there."""
+        with self._lock:
+            sites = ([site] if site is not None
+                     else list(self._armed.keys() | self._hanging.keys()))
+            for s in sites:
+                spec = self._armed.pop(s, None)
+                if spec is not None and spec.release_event is not None:
+                    spec.release_event.set()
+                for ev in self._hanging.pop(s, []):
+                    ev.set()
+
+    def release(self, site: str) -> None:
+        """Unblock threads hung at ``site`` without disarming it."""
+        with self._lock:
+            spec = self._armed.get(site)
+            hanging = list(self._hanging.get(site, []))
+        if spec is not None and spec.release_event is not None:
+            spec.release_event.set()
+        for ev in hanging:
+            ev.set()
+
+    def _enter_hang(self, site: str, ev: threading.Event) -> None:
+        with self._lock:
+            self._hanging.setdefault(site, []).append(ev)
+
+    def _exit_hang(self, site: str, ev: threading.Event) -> None:
+        with self._lock:
+            evs = self._hanging.get(site)
+            if evs is not None:
+                try:
+                    evs.remove(ev)
+                except ValueError:
+                    pass
+                if not evs:
+                    self._hanging.pop(site, None)
+
+    def fired(self, site: str) -> int:
+        with self._lock:
+            return self._fired.get(site, 0)
+
+    # -- firing ------------------------------------------------------------
+    def _take(self, site: str) -> FaultSpec | None:
+        """Book one firing at ``site``; returns the spec to execute, or
+        None when nothing (still) armed there."""
+        with self._lock:
+            spec = self._armed.get(site)
+            if spec is None:
+                return None
+            if spec.times is not None:
+                if spec.times <= 0:
+                    self._armed.pop(site, None)
+                    return None
+                spec.times -= 1
+                if spec.times == 0:
+                    # disarm now; threads already inside keep their spec
+                    self._armed.pop(site, None)
+            self._fired[site] = self._fired.get(site, 0) + 1
+            return spec
+
+    def fire(self, site: str) -> None:
+        """Synchronous site (worker thread / sync handler). No-op unless
+        armed."""
+        if not self._armed:
+            return
+        spec = self._take(site)
+        if spec is None:
+            return
+        if spec.kind == "error":
+            raise spec.exc if spec.exc is not None else FaultInjected(site)
+        if spec.kind == "slow":
+            time.sleep(spec.delay_s)
+            return
+        assert spec.release_event is not None
+        self._enter_hang(site, spec.release_event)
+        try:
+            spec.release_event.wait(spec.max_hang_s)
+        finally:
+            self._exit_hang(site, spec.release_event)
+
+    async def afire(self, site: str) -> None:
+        """Async site (aiohttp handler / publisher task): sleeps and hangs
+        must suspend the coroutine, never block the event loop."""
+        if not self._armed:
+            return
+        spec = self._take(site)
+        if spec is None:
+            return
+        if spec.kind == "error":
+            raise spec.exc if spec.exc is not None else FaultInjected(site)
+        if spec.kind == "slow":
+            await asyncio.sleep(spec.delay_s)
+            return
+        assert spec.release_event is not None
+        self._enter_hang(site, spec.release_event)
+        try:
+            await asyncio.to_thread(spec.release_event.wait, spec.max_hang_s)
+        finally:
+            self._exit_hang(site, spec.release_event)
+
+
+#: Process-wide registry. Serving code fires against this; chaos tests
+#: arm it and MUST clear it on teardown (tests/conftest.py's chaos guard
+#: clears it for marked tests).
+FAULTS = FaultInjector()

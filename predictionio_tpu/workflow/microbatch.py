@@ -46,9 +46,11 @@ import logging
 import time
 from typing import Any, Callable, Sequence
 
+from .faults import FAULTS
+
 log = logging.getLogger("predictionio_tpu.server")
 
-__all__ = ["MicroBatcher", "ServerBusy"]
+__all__ = ["MicroBatcher", "ServerBusy", "DeadlineExceeded", "DispatchTimeout"]
 
 
 class ServerBusy(RuntimeError):
@@ -56,6 +58,19 @@ class ServerBusy(RuntimeError):
     HTTP layer maps it to 503 so overload sheds load instead of queueing
     without bound (the reference's per-query dispatch is implicitly
     bounded by its thread pool)."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The query's end-to-end deadline passed while it was queued (or
+    before submission) — the HTTP layer maps it to 504. An expired query
+    is failed at batch-formation time and never consumes a batch slot."""
+
+
+class DispatchTimeout(RuntimeError):
+    """A dispatched batch exceeded the stuck-dispatch watchdog timeout.
+    Its semaphore slot is reclaimed (the hung worker thread is tracked as
+    a zombie), its queries 504, and the on_watchdog hook fires so the
+    server can flip into degraded mode."""
 
 
 class MicroBatcher:
@@ -70,6 +85,8 @@ class MicroBatcher:
         max_pending: int = 1024,
         max_inflight: int = 8,
         adaptive: bool = False,
+        dispatch_timeout_s: float | None = None,
+        on_watchdog: Callable[[], None] | None = None,
     ):
         self.batch_fn = batch_fn
         self.max_batch = max(1, max_batch)
@@ -77,22 +94,35 @@ class MicroBatcher:
         self.max_pending = max(1, max_pending)
         self.max_inflight = max(1, max_inflight)
         self.adaptive = adaptive
+        #: stuck-dispatch watchdog: a batch_fn call exceeding this wall
+        #: time has its futures failed (DispatchTimeout) and its
+        #: semaphore slot reclaimed; the thread keeps running as a
+        #: tracked zombie (to_thread work cannot be interrupted). None
+        #: disables (pre-watchdog behavior: a hang wedges a slot forever).
+        self.dispatch_timeout_s = dispatch_timeout_s
+        #: called (no args, on the event loop) after each watchdog trip —
+        #: the engine server hooks degraded mode here
+        self.on_watchdog = on_watchdog
         # adaptive-window state: EWMA of inter-arrival gaps + last arrival
         self._ewma_iv: float | None = None
         self._last_arrival: float | None = None
         self.last_window_s = 0.0 if adaptive else self.window_s
-        self._pending: list[tuple[Any, asyncio.Future]] = []
+        #: (query, future, absolute-monotonic deadline | None)
+        self._pending: list[tuple[Any, asyncio.Future, float | None]] = []
         self._wake: asyncio.Event | None = None
         self._task: asyncio.Task | None = None
         self._sem: asyncio.Semaphore | None = None
         self._inflight: set[asyncio.Task] = set()
         self._live = 0  # dispatches currently holding a semaphore slot
+        self._zombies = 0  # hung batch_fn threads the watchdog abandoned
         self._closing = False
         # observability: how well batching + pipelining are working
         self.batches = 0
         self.batched_queries = 0
         self.max_seen_batch = 0
         self.peak_inflight = 0
+        self.watchdog_trips = 0
+        self.deadline_expired = 0
 
     def _ensure_started(self) -> None:
         if self._task is None or self._task.done():
@@ -100,14 +130,24 @@ class MicroBatcher:
             self._sem = asyncio.Semaphore(self.max_inflight)
             self._task = asyncio.create_task(self._run())
 
-    async def submit(self, query: Any) -> Any:
+    async def submit(self, query: Any, *, deadline: float | None = None) -> Any:
         """Enqueue one query; resolves to its result (or raises its own
-        error) when its batch completes. Raises ServerBusy at capacity."""
+        error) when its batch completes. Raises ServerBusy at capacity.
+
+        ``deadline``: absolute ``time.monotonic()`` instant after which
+        the query is worthless to its caller — an already-expired submit
+        raises DeadlineExceeded immediately, and a query whose deadline
+        passes while queued is failed at batch-formation time WITHOUT
+        consuming a batch slot (the load balancer's 504, not a wasted
+        device call)."""
         if self._closing:
             # close() is mid-drain: starting a fresh worker generation now
             # would either leak it or have close() cancel this future —
             # shed instead (the HTTP layer answers 503)
             raise ServerBusy("micro-batcher is shutting down")
+        if deadline is not None and time.monotonic() >= deadline:
+            self.deadline_expired += 1
+            raise DeadlineExceeded("request deadline expired before submit")
         if len(self._pending) >= self.max_pending:
             raise ServerBusy(
                 f"micro-batch queue full ({self.max_pending} pending)")
@@ -115,7 +155,7 @@ class MicroBatcher:
         if self.adaptive:
             self._note_arrival(time.monotonic())
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._pending.append((query, fut))
+        self._pending.append((query, fut, deadline))
         assert self._wake is not None
         self._wake.set()
         return await fut
@@ -148,7 +188,29 @@ class MicroBatcher:
             return 0.0
         return min(self.window_s, need * iv)
 
+    def set_max_inflight(self, n: int) -> None:
+        """Resize the dispatch pipeline (degraded mode shrinks it, recovery
+        restores it). Takes effect on the next batch formation: each formed
+        batch captures the semaphore generation it acquired from, so
+        straddling dispatches release the slot they actually hold."""
+        self.max_inflight = max(1, n)
+        if self._sem is not None:
+            self._sem = asyncio.Semaphore(self.max_inflight)
+
     async def close(self) -> None:
+        """Hard stop: cancel the worker, let in-flight batches finish,
+        FAIL anything still queued (CancelledError). For the graceful
+        variant that flushes the queue instead, see drain()."""
+        await self._shutdown(flush=False)
+
+    async def drain(self) -> None:
+        """Graceful drain (SIGTERM / /stop): stop accepting, FLUSH the
+        queued queries as immediate batches, wait for every in-flight
+        dispatch, then stop the worker. Queued callers get answers, not
+        cancellations; expired deadlines still 504."""
+        await self._shutdown(flush=True)
+
+    async def _shutdown(self, *, flush: bool) -> None:
         self._closing = True  # submit() sheds until the drain finishes
         try:
             if self._task is not None:
@@ -158,6 +220,19 @@ class MicroBatcher:
                 except (asyncio.CancelledError, Exception):  # noqa: BLE001
                     pass
                 self._task = None
+            if flush:
+                # dispatch everything still queued, no window — the point
+                # of the drain is answering admitted requests, fast
+                self._sweep_expired(time.monotonic())
+                while self._pending:
+                    batch = self._pending[: self.max_batch]
+                    del self._pending[: len(batch)]
+                    sem = self._sem
+                    assert sem is not None  # pending implies started
+                    await sem.acquire()
+                    task = asyncio.create_task(self._dispatch(batch, sem))
+                    self._inflight.add(task)
+                    task.add_done_callback(self._inflight.discard)
             # let dispatched batches finish — their queries already left
             # the queue and their callers are awaiting results; to_thread
             # work cannot be interrupted anyway
@@ -167,11 +242,27 @@ class MicroBatcher:
             # fail anything still queued — a caller awaiting submit() must
             # not hang forever because shutdown won the race with its batch
             pending, self._pending = self._pending, []
-            for _, fut in pending:
+            for _, fut, _ in pending:
                 if not fut.done():
                     fut.set_exception(asyncio.CancelledError("batcher closed"))
         finally:
             self._closing = False  # a later submit() may restart cleanly
+
+    def _sweep_expired(self, now: float) -> None:
+        """Fail queued queries whose deadline passed (504) so they never
+        consume a batch slot; runs at every batch-formation point."""
+        if not any(d is not None and d <= now for _, _, d in self._pending):
+            return
+        keep: list[tuple[Any, asyncio.Future, float | None]] = []
+        for query, fut, dl in self._pending:
+            if dl is not None and dl <= now:
+                self.deadline_expired += 1
+                if not fut.done():
+                    fut.set_exception(DeadlineExceeded(
+                        "request deadline expired while queued"))
+            else:
+                keep.append((query, fut, dl))
+        self._pending[:] = keep
 
     async def _run(self) -> None:
         """Batch-formation loop: serializes windowing + arrival order,
@@ -184,47 +275,101 @@ class MicroBatcher:
             if w > 0 and len(self._pending) < self.max_batch:
                 # window open: let concurrent requests pile in
                 await asyncio.sleep(w)
+            # expired queries 504 here, before a slot is spent on them
+            self._sweep_expired(time.monotonic())
             # bound in-flight BEFORE taking queries off the queue, so a
             # saturated pipeline backpressures into max_pending/503 land
-            # instead of stripping the queue into waiting tasks
-            await self._sem.acquire()
+            # instead of stripping the queue into waiting tasks. Capture
+            # THIS generation's semaphore: set_max_inflight (degraded
+            # mode) swaps self._sem mid-run, and a straddling dispatch
+            # must release the slot it actually acquired.
+            sem = self._sem
+            await sem.acquire()
+            self._sweep_expired(time.monotonic())  # slot waits take time
             batch = self._pending[: self.max_batch]
             del self._pending[: len(batch)]
             if not self._pending:
                 self._wake.clear()
             if not batch:
-                self._sem.release()
+                sem.release()
                 continue
-            # hand THIS generation's semaphore to the dispatch: a restart
-            # replaces self._sem, and a straddling dispatch must release
-            # the slot it actually acquired, not the new generation's
-            task = asyncio.create_task(self._dispatch(batch, self._sem))
+            task = asyncio.create_task(self._dispatch(batch, sem))
             self._inflight.add(task)
             task.add_done_callback(self._inflight.discard)
 
-    async def _dispatch(self, batch: list[tuple[Any, asyncio.Future]],
+    def _call_batch_fn(self, queries: list) -> list:
+        """Runs in the dispatch worker thread; the chaos harness's hang/
+        error/slow site for 'a device call wedged' lives here so an
+        injected hang occupies the thread exactly like a real one."""
+        FAULTS.fire("microbatch.dispatch")
+        return self.batch_fn(queries)
+
+    def _zombie_done(self, task: asyncio.Task) -> None:
+        self._zombies -= 1
+        if not task.cancelled() and task.exception() is not None:
+            # retrieve it (else asyncio logs "exception never retrieved");
+            # the batch's futures were already failed by the watchdog
+            log.warning("abandoned dispatch finally failed: %s",
+                        task.exception())
+        else:
+            log.info("abandoned dispatch thread finally returned "
+                     "(%d zombie(s) left)", self._zombies)
+
+    async def _dispatch(self, batch: list[tuple[Any, asyncio.Future, Any]],
                         sem: asyncio.Semaphore) -> None:
         """Serve ONE formed batch; owns (and releases) one slot of the
-        semaphore it was formed under."""
+        semaphore it was formed under. With dispatch_timeout_s set, a
+        batch_fn call that outlives the watchdog has its futures failed
+        (504) and its slot reclaimed; the un-interruptible worker thread
+        is tracked as a zombie until it returns."""
         self._live += 1
         self.peak_inflight = max(self.peak_inflight, self._live)
         try:
-            queries = [q for q, _ in batch]
+            queries = [q for q, _, _ in batch]
+            inner = asyncio.ensure_future(
+                asyncio.to_thread(self._call_batch_fn, queries))
             try:
-                outcomes = await asyncio.to_thread(self.batch_fn, queries)
+                if self.dispatch_timeout_s is not None:
+                    # shield: on timeout the outer wait is cancelled but
+                    # the thread task keeps running (tracked below)
+                    outcomes = await asyncio.wait_for(
+                        asyncio.shield(inner), self.dispatch_timeout_s)
+                else:
+                    outcomes = await inner
                 if len(outcomes) != len(batch):
                     raise RuntimeError(
                         f"batch_fn returned {len(outcomes)} outcomes for "
                         f"{len(batch)} queries")
+            except asyncio.TimeoutError:
+                self.watchdog_trips += 1
+                self._zombies += 1
+                inner.add_done_callback(self._zombie_done)
+                log.error(
+                    "watchdog: batch of %d stuck > %.1fs; reclaiming its "
+                    "pipeline slot (trip #%d, %d zombie thread(s))",
+                    len(batch), self.dispatch_timeout_s,
+                    self.watchdog_trips, self._zombies)
+                err = DispatchTimeout(
+                    f"batch dispatch exceeded {self.dispatch_timeout_s}s "
+                    f"watchdog; slot reclaimed")
+                for _, fut, _ in batch:
+                    if not fut.done():
+                        fut.set_exception(err)
+                if self.on_watchdog is not None:
+                    try:
+                        self.on_watchdog()
+                    except Exception:  # noqa: BLE001 — hook must not kill
+                        log.exception("on_watchdog hook failed")
+                return
             except Exception as e:  # noqa: BLE001 — batch-level failure
-                for _, fut in batch:
+                for _, fut, _ in batch:
                     if not fut.done():
                         fut.set_exception(e)
                 return
             self.batches += 1
             self.batched_queries += len(batch)
             self.max_seen_batch = max(self.max_seen_batch, len(batch))
-            for (_, fut), (tag, payload) in zip(batch, outcomes):
+            for (_, fut, _), (tag, payload) in zip(batch, outcomes):
                 if fut.done():
                     continue
                 if tag == "ok":
@@ -250,4 +395,8 @@ class MicroBatcher:
             "occupancy": self._live / self.max_inflight,
             "arrivalIntervalMs": (self._ewma_iv * 1e3
                                   if self._ewma_iv is not None else None),
+            "dispatchTimeoutS": self.dispatch_timeout_s,
+            "watchdogTrips": self.watchdog_trips,
+            "zombieDispatches": self._zombies,
+            "deadlineExpired": self.deadline_expired,
         }

@@ -35,6 +35,46 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "multihost: spawns multiple jax.distributed CPU processes")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fault-injection resilience tests (CPU-fast, deterministic "
+        "via predictionio_tpu.workflow.faults; guarded by a per-test "
+        "SIGALRM timeout so an injected hang cannot wedge the suite)")
+
+
+#: Hard per-test budget for chaos tests. Injected hangs are capped at
+#: FaultSpec.max_hang_s (default 30 s) well below this; the alarm is the
+#: backstop that keeps a buggy recovery path from eating the tier-1
+#: 870 s budget.
+CHAOS_TEST_TIMEOUT_S = 120
+
+
+@pytest.fixture(autouse=True)
+def _chaos_guard(request):
+    """For @pytest.mark.chaos tests: arm a SIGALRM watchdog (pytest-timeout
+    is not in the image) and always disarm every injected fault on
+    teardown — a leaked armed fault would poison unrelated tests."""
+    if request.node.get_closest_marker("chaos") is None:
+        yield
+        return
+
+    import signal
+
+    from predictionio_tpu.workflow.faults import FAULTS
+
+    def _expired(signum, frame):
+        FAULTS.clear()  # release hung threads before failing the test
+        raise TimeoutError(
+            f"chaos test exceeded {CHAOS_TEST_TIMEOUT_S}s guard")
+
+    old_handler = signal.signal(signal.SIGALRM, _expired)
+    signal.setitimer(signal.ITIMER_REAL, CHAOS_TEST_TIMEOUT_S)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, old_handler)
+        FAULTS.clear()
 
 
 @pytest.fixture(autouse=True)
