@@ -1,6 +1,9 @@
-"""REST API plane: event server, stats, webhooks (L3 of the framework)."""
+"""REST API plane: event server, durable ingestion, stats, webhooks
+(L3 of the framework)."""
 
 from .event_server import AuthData, create_event_app, run_event_server
+from .ingest import DurableIngestor
 from .stats import Stats
 
-__all__ = ["AuthData", "Stats", "create_event_app", "run_event_server"]
+__all__ = ["AuthData", "DurableIngestor", "Stats", "create_event_app",
+           "run_event_server"]

@@ -11,6 +11,8 @@ re-built on asyncio/aiohttp. Route surface kept wire-compatible:
 - ``GET  /events/<id>.json``     -> one event
 - ``DELETE /events/<id>.json``   -> {"message": "Found"} | 404
 - ``GET  /stats.json``           -> ingestion counters (with --stats)
+- ``GET  /health.json``          -> ok/degraded + journal lag (no auth,
+  engine-server parity — wire it as the LB readiness check)
 - ``POST /webhooks/<name>.json`` -> JSON connector ingestion
 - ``POST /webhooks/<name>``      -> form connector ingestion
 - ``GET  /webhooks/<name>[.json]`` -> connector presence check
@@ -18,6 +20,12 @@ re-built on asyncio/aiohttp. Route surface kept wire-compatible:
 Auth: ``?accessKey=`` resolved against the metadata store; optional
 ``?channel=`` resolved per app (EventAPI.scala:88-116). Event writes run
 in a thread pool so slow storage never blocks the accept loop.
+
+Durable mode (``pio eventserver --journal-dir ...``): writes ack 201
+after a durable append to the ingestion journal (storage/journal.py) and
+a background drainer pushes them into the event backend — a storage
+outage degrades reads, never loses acked events (api/ingest.py). A full
+journal answers **503 + Retry-After** (backpressure, not silent loss).
 """
 
 from __future__ import annotations
@@ -38,8 +46,10 @@ from ..storage import (
     event_to_api_dict,
 )
 from ..storage.event import _dt_from_wire
-from ..storage.events_base import StorageError
+from ..storage.events_base import StorageError, TableNotInitialized
+from ..storage.journal import JournalFull
 from ..workflow.faults import FAULTS
+from .ingest import DurableIngestor
 from .stats import Stats
 from .webhooks import ConnectorException, FormConnector, JsonConnector, get_connector
 
@@ -48,6 +58,12 @@ log = logging.getLogger("predictionio_tpu.eventserver")
 __all__ = ["create_event_app", "run_event_server", "AuthData"]
 
 STATS_KEY = web.AppKey("stats", object)
+INGEST_KEY = web.AppKey("ingest", object)
+
+#: Retry-After seconds on journal-full 503s — long enough for the
+#: drainer to free a segment, short enough that clients probe a
+#: recovering server promptly.
+BACKPRESSURE_RETRY_AFTER_S = 1
 
 
 @dataclass
@@ -143,9 +159,24 @@ async def _insert_one(
 ) -> tuple[int, dict]:
     """Insert one already-validated Event; returns (status, body).
 
-    Re-inserting an event the backend already persisted is idempotent at
-    the storage layer only if the backend deduplicates; the API contract
-    here mirrors the reference's (each POST is one event record)."""
+    With a journal configured, the ack means "durably journaled" and the
+    backend write happens on the drainer's schedule; otherwise it is a
+    direct backend insert. Re-inserting an event the backend already
+    persisted is idempotent at the storage layer only if the backend
+    deduplicates; the API contract here mirrors the reference's (each
+    POST is one event record)."""
+    ingest: DurableIngestor | None = request.app.get(INGEST_KEY)
+    if ingest is not None:
+        e = ingest.assign_id(event)
+        appended, err = await ingest.submit([e], auth.app_id, auth.channel_id)
+        if appended == 1:
+            _bump_stats(request, auth.app_id, 201, e)
+            return 201, {"eventId": e.event_id}
+        if err is None:
+            _bump_stats(request, auth.app_id, 503, event)
+            return 503, {"message": "event journal at capacity; retry"}
+        _bump_stats(request, auth.app_id, 500, event)
+        return 500, {"message": f"journal append failed: {err}"}
     events = Storage.get_events()
     try:
         # chaos site: arm a StorageError here to exercise the real
@@ -173,6 +204,17 @@ async def _insert_event_dict(
     return await _insert_one(request, auth, validated)
 
 
+def _ingest_response(status: int, body) -> web.Response:
+    """json_response + the backpressure contract: every 503 (or batch
+    containing one) carries Retry-After so well-behaved clients pace
+    themselves instead of hammering a full journal."""
+    full = status == 503 or (
+        isinstance(body, list)
+        and any(isinstance(x, dict) and x.get("status") == 503 for x in body))
+    headers = {"Retry-After": str(BACKPRESSURE_RETRY_AFTER_S)} if full else None
+    return web.json_response(body, status=status, headers=headers)
+
+
 # -- handlers ---------------------------------------------------------------
 
 async def handle_root(request: web.Request) -> web.Response:
@@ -189,7 +231,7 @@ async def handle_post_event(request: web.Request) -> web.Response:
         _bump_stats(request, auth.app_id, 400)
         return _json_error(400, "Malformed JSON body.")
     status, body = await _insert_event_dict(request, auth, data)
-    return web.json_response(body, status=status)
+    return _ingest_response(status, body)
 
 
 async def handle_post_batch(request: web.Request) -> web.Response:
@@ -230,7 +272,29 @@ async def handle_post_batch(request: web.Request) -> web.Response:
             continue
         results.append(None)  # filled from the batch insert below
         valid.append((len(results) - 1, validated))
-    if valid:
+    ingest: DurableIngestor | None = request.app.get(INGEST_KEY)
+    if valid and ingest is not None:
+        # durable mode: ONE journal append run + ONE fsync for the whole
+        # batch (the fsync-amortization point of the `batch` policy); the
+        # backend write happens on the drainer's schedule. A mid-run
+        # JournalFull acks the appended prefix and 503s the rest —
+        # per-event statuses stay exact, nothing is silently dropped.
+        withids = [(slot, ingest.assign_id(e)) for slot, e in valid]
+        appended, err = await ingest.submit(
+            [e for _, e in withids], auth.app_id, auth.channel_id)
+        for i, (slot, e) in enumerate(withids):
+            if i < appended:
+                results[slot] = {"status": 201, "eventId": e.event_id}
+                _bump_stats(request, auth.app_id, 201, e)
+            elif err is None:
+                results[slot] = {"status": 503,
+                                 "message": "event journal at capacity; retry"}
+                _bump_stats(request, auth.app_id, 503, e)
+            else:
+                results[slot] = {"status": 500,
+                                 "message": f"journal append failed: {err}"}
+                _bump_stats(request, auth.app_id, 500, e)
+    elif valid:
         events_dao = Storage.get_events()
         # only atomic backends take the one-call fast path: a non-atomic
         # backend could persist a prefix of the batch before failing, and
@@ -269,7 +333,7 @@ async def handle_post_batch(request: web.Request) -> web.Response:
             for slot, event in valid:
                 status, body = await _insert_one(request, auth, event)
                 results[slot] = {"status": status, **body}
-    return web.json_response(results, status=200)
+    return _ingest_response(200, results)
 
 
 async def handle_get_events(request: web.Request) -> web.Response:
@@ -304,8 +368,12 @@ async def handle_get_events(request: web.Request) -> web.Response:
     events = Storage.get_events()
     try:
         found = await asyncio.to_thread(lambda: list(events.find(query)))
-    except StorageError as e:
+    except TableNotInitialized as e:
+        # an app whose table was never init'd legitimately has no events
         return _json_error(404, str(e))
+    except StorageError as e:
+        # a real backend outage must NOT masquerade as "Not Found"
+        return _json_error(500, str(e))
     if not found:
         # reference returns 404 on empty result (EventAPI.scala:255-260)
         return _json_error(404, "Not Found")
@@ -320,8 +388,10 @@ async def handle_get_event(request: web.Request) -> web.Response:
     events = Storage.get_events()
     try:
         e = await asyncio.to_thread(events.get, event_id, auth.app_id, auth.channel_id)
-    except StorageError as err:
+    except TableNotInitialized as err:
         return _json_error(404, str(err))
+    except StorageError as err:
+        return _json_error(500, str(err))
     if e is None:
         return _json_error(404, "Not Found")
     return web.json_response(event_to_api_dict(e))
@@ -337,8 +407,10 @@ async def handle_delete_event(request: web.Request) -> web.Response:
         found = await asyncio.to_thread(
             events.delete, event_id, auth.app_id, auth.channel_id
         )
-    except StorageError as err:
+    except TableNotInitialized as err:
         return _json_error(404, str(err))
+    except StorageError as err:
+        return _json_error(500, str(err))
     if found:
         return web.json_response({"message": "Found"})
     return _json_error(404, "Not Found")
@@ -353,7 +425,27 @@ async def handle_stats(request: web.Request) -> web.Response:
         return _json_error(
             404, "To see stats, launch Event Server with --stats argument."
         )
-    return web.json_response(stats.get(auth.app_id))
+    body = stats.get(auth.app_id)
+    ingest: DurableIngestor | None = request.app.get(INGEST_KEY)
+    if ingest is not None:
+        # journal/drain counters are server-wide (one journal serves every
+        # app), reported alongside the per-app ingest bookkeeping
+        body["ingest"] = ingest.stats()
+    return web.json_response(body)
+
+
+async def handle_health(request: web.Request) -> web.Response:
+    """Liveness/readiness, engine-server parity (create_server.py): no
+    auth — load balancers probe this. 200 with ``ok`` or ``degraded``
+    (acks still flow in degraded; only the backend push path is down),
+    and the journal lag / unsynced bytes an autoscaler or operator needs."""
+    ingest: DurableIngestor | None = request.app.get(INGEST_KEY)
+    if ingest is None:
+        body = {"status": "ok", "live": True, "ready": True,
+                "journal": None, "drain": None}
+    else:
+        body = ingest.health()
+    return web.json_response(body)
 
 
 async def handle_webhook_post(request: web.Request) -> web.Response:
@@ -384,7 +476,7 @@ async def handle_webhook_post(request: web.Request) -> web.Response:
         _bump_stats(request, auth.app_id, 400)
         return _json_error(400, "Malformed body.")
     status, body = await _insert_event_dict(request, auth, event_json)
-    return web.json_response(body, status=status)
+    return _ingest_response(status, body)
 
 
 async def handle_webhook_get(request: web.Request) -> web.Response:
@@ -400,9 +492,14 @@ async def handle_webhook_get(request: web.Request) -> web.Response:
     return _json_error(404, f"webhooks connection for {name} is not supported.")
 
 
-def create_event_app(stats: bool = False) -> web.Application:
+def create_event_app(stats: bool = False,
+                     ingestor: DurableIngestor | None = None) -> web.Application:
+    """``ingestor`` switches the write path to durable journal-acked
+    mode; its lifecycle (startup replay, background drainer, final
+    fsync) rides the app's startup/cleanup signals."""
     app = web.Application()
     app[STATS_KEY] = Stats() if stats else None
+    app[INGEST_KEY] = ingestor
     app.router.add_get("/", handle_root)
     app.router.add_post("/events.json", handle_post_event)
     app.router.add_post("/batch/events.json", handle_post_batch)
@@ -410,14 +507,38 @@ def create_event_app(stats: bool = False) -> web.Application:
     app.router.add_get("/events/{event_id}.json", handle_get_event)
     app.router.add_delete("/events/{event_id}.json", handle_delete_event)
     app.router.add_get("/stats.json", handle_stats)
+    app.router.add_get("/health.json", handle_health)
     app.router.add_post("/webhooks/{name}", handle_webhook_post)
     app.router.add_get("/webhooks/{name}", handle_webhook_get)
+    if ingestor is not None:
+        async def _start_ingest(app):
+            # replay undrained records from a previous process BEFORE the
+            # listener takes traffic (runner.setup runs startup first)
+            await ingestor.start()
+
+        async def _stop_ingest(app):
+            await ingestor.aclose()
+
+        app.on_startup.append(_start_ingest)
+        app.on_cleanup.append(_stop_ingest)
     return app
 
 
-def run_event_server(ip: str = "0.0.0.0", port: int = 7070, stats: bool = False) -> None:
+def run_event_server(ip: str = "0.0.0.0", port: int = 7070,
+                     stats: bool = False, journal_dir: str | None = None,
+                     journal_fsync: str = "batch",
+                     journal_max_mb: int = 256) -> None:
     """Blocking entry (reference: EventServer.createEventServer,
-    EventAPI.scala:449-468; default port 7070)."""
+    EventAPI.scala:449-468; default port 7070). ``journal_dir`` enables
+    durable ingestion (ack-from-journal, background drain)."""
     logging.basicConfig(level=logging.INFO)
+    ingestor = None
+    if journal_dir:
+        ingestor = DurableIngestor(
+            journal_dir, fsync=journal_fsync,
+            max_bytes=int(journal_max_mb) * 1024 * 1024)
+        log.info("Durable ingestion: journal at %s (fsync=%s, cap=%dMB)",
+                 journal_dir, journal_fsync, journal_max_mb)
     log.info("Event server starting on %s:%d", ip, port)
-    web.run_app(create_event_app(stats=stats), host=ip, port=port, print=None)
+    web.run_app(create_event_app(stats=stats, ingestor=ingestor),
+                host=ip, port=port, print=None)

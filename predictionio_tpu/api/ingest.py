@@ -1,0 +1,323 @@
+"""Durable ingestion: journal-backed acks + the outage-tolerant drainer.
+
+The event server's write path with a journal configured becomes:
+
+    POST /events.json -> validate -> assign event id -> journal append
+    (+ fsync per policy) -> 201 {"eventId": ...}
+
+and a single background drainer owns the journal-to-backend pipe: it
+reads undrained records in append order, pushes ordered batches into the
+``EventBackend``, and only then advances the persisted cursor. A storage
+outage therefore costs availability of READS, never of ingestion — the
+201 contract is "durably journaled", the same promise the reference's
+HBase WAL gave it (and the posture streaming-log training pipelines
+take: capture first, apply later).
+
+Failure handling reuses the ``workflow/feedback.py`` pattern:
+
+- a closed → open → half-open **circuit breaker** around backend pushes
+  (past ``breaker_threshold`` consecutive failures the drainer stops
+  hammering and probes once per ``breaker_reset_s``);
+- **jittered exponential backoff** between failed pushes so a recovering
+  backend is not thundering-herded;
+- unlike feedback, the drainer NEVER drops: records wait in the journal
+  until the backend takes them (backpressure past the journal cap is
+  the server's 503, storage/journal.py).
+
+Exactly-once effect: event ids are assigned before the append, and both
+built-in backends upsert by id (``INSERT OR REPLACE`` / dict replace) —
+a batch that half-landed before a crash or error is simply re-pushed.
+
+Chaos site: ``eventserver.drain`` fires before every backend push
+(async), so a hard outage is provable in tests (workflow/faults.py).
+
+``start()`` replays undrained records from a previous process before the
+server starts accepting traffic (reachable backend), or leaves them to
+the background drainer (unreachable backend — the server still accepts,
+that is the point).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import random
+import time
+import uuid
+
+from ..storage import Storage, event_from_api_dict, event_to_api_dict
+from ..storage.journal import EventJournal, JournalFull
+from ..workflow.faults import FAULTS
+
+log = logging.getLogger("predictionio_tpu.eventserver")
+
+__all__ = ["DurableIngestor", "JournalFull"]
+
+
+class DurableIngestor:
+    """Owns the event server's journal, drainer task and breaker."""
+
+    def __init__(
+        self,
+        journal_dir: str,
+        *,
+        fsync: str = "batch",
+        max_bytes: int = 256 * 1024 * 1024,
+        segment_max_bytes: int | None = None,
+        drain_batch: int = 64,
+        breaker_threshold: int = 5,
+        breaker_reset_s: float = 5.0,
+        backoff_base_s: float = 0.1,
+        backoff_cap_s: float = 2.0,
+    ):
+        if segment_max_bytes is None:
+            # a handful of segments inside the cap so GC frees space in
+            # file-sized steps well before the 503 threshold
+            segment_max_bytes = min(16 * 1024 * 1024,
+                                    max(64 * 1024, max_bytes // 4))
+        self.journal = EventJournal(
+            journal_dir, fsync=fsync, max_bytes=max_bytes,
+            segment_max_bytes=segment_max_bytes)
+        self.drain_batch = max(1, drain_batch)
+        self.breaker_threshold = max(1, breaker_threshold)
+        self.breaker_reset_s = breaker_reset_s
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self._task: asyncio.Task | None = None
+        self._wake: asyncio.Event | None = None
+        self._closing = False
+        # breaker state (the feedback.py machine, minus the drop path)
+        self._state = "closed"  # closed | open | half_open
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._last_error: str | None = None
+        # counters
+        self.drained_batches = 0
+        self.drain_failures = 0
+        self.breaker_opens = 0
+
+    # -- ingest-side API ---------------------------------------------------
+    def encode(self, event, app_id: int, channel_id: int | None) -> bytes:
+        """One journal payload. The event id MUST already be assigned —
+        it is what makes replay idempotent."""
+        assert event.event_id, "journal records require a pre-assigned id"
+        return json.dumps(
+            {"e": event_to_api_dict(event), "a": app_id, "c": channel_id},
+            separators=(",", ":")).encode()
+
+    @staticmethod
+    def assign_id(event):
+        return event if event.event_id else event.with_id(uuid.uuid4().hex)
+
+    async def submit(self, events, app_id: int,
+                     channel_id: int | None) -> tuple[int, Exception | None]:
+        """Durably append ``events`` (ids already assigned) in order;
+        returns ``(appended, error)``. ``appended`` events are synced per
+        the fsync policy and safe to ack 201; a ``JournalFull`` stop
+        reports ``error=None`` (ack the rest 503), any other error is
+        returned for a 500."""
+        payloads = [self.encode(e, app_id, channel_id) for e in events]
+        n, err = await asyncio.to_thread(self._append_batch, payloads)
+        if n and self._wake is not None:
+            self._wake.set()
+        return n, err
+
+    def _append_batch(self, payloads: list[bytes]) -> tuple[int, Exception | None]:
+        n = 0
+        err: Exception | None = None
+        try:
+            for p in payloads:
+                self.journal.append(p)
+                n += 1
+        except JournalFull:
+            pass  # appended prefix still acks; the rest is backpressure
+        except Exception as e:  # noqa: BLE001 — injected/disk faults -> 500
+            err = e
+        # whatever happened after them, the appended records must be
+        # durable before their 201s leave (policy `always` synced inline)
+        if n and self.journal.fsync_policy == "batch":
+            try:
+                self.journal.sync()
+            except Exception as e:  # noqa: BLE001
+                # unsynced appends may not survive a power cut — do not ack
+                return 0, err or e
+        return n, err
+
+    # -- breaker -----------------------------------------------------------
+    def _breaker_allows(self, now: float) -> bool:
+        if self._state == "closed":
+            return True
+        if self._state == "open":
+            if now - self._opened_at >= self.breaker_reset_s:
+                self._state = "half_open"
+                return True
+            return False
+        return True  # half_open: the drainer IS the single probe
+
+    def _on_push_success(self) -> None:
+        if self._state != "closed":
+            log.info("ingest drain breaker closed (backend recovered, "
+                     "lag=%d)", self.journal.lag)
+        self._state = "closed"
+        self._consecutive_failures = 0
+        self._last_error = None
+
+    def _on_push_failure(self, err: Exception) -> None:
+        self.drain_failures += 1
+        self._consecutive_failures += 1
+        self._last_error = str(err)
+        if self._state == "half_open" or (
+                self._state == "closed"
+                and self._consecutive_failures >= self.breaker_threshold):
+            if self._state != "open":
+                self.breaker_opens += 1
+                log.warning(
+                    "ingest drain breaker OPEN after %d consecutive "
+                    "failures (last: %s); events keep acking into the "
+                    "journal, lag=%d", self._consecutive_failures, err,
+                    self.journal.lag)
+            self._state = "open"
+            self._opened_at = time.monotonic()
+
+    # -- drain loop --------------------------------------------------------
+    async def _drain_once(self) -> bool:
+        """Push one ordered batch; True on progress (or nothing to do)."""
+        records, pos = await asyncio.to_thread(
+            self.journal.peek_batch, self.drain_batch)
+        if not records:
+            return True
+        try:
+            # chaos site: arm an error here for a deterministic backend
+            # outage the acks must survive (workflow/faults.py)
+            await FAULTS.afire("eventserver.drain")
+            await asyncio.to_thread(self._push_records, records)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — any backend failure retries
+            self._on_push_failure(e)
+            return False
+        await asyncio.to_thread(self.journal.advance, pos)
+        self.drained_batches += 1
+        self._on_push_success()
+        return True
+
+    def _push_records(self, records: list[bytes]) -> None:
+        """Decode + insert in journal order, grouping consecutive records
+        of one (app, channel) into one backend batch call."""
+        backend = Storage.get_events()
+        group: list = []
+        group_key: tuple[int, int | None] | None = None
+
+        def flush():
+            if group:
+                backend.insert_batch(group, group_key[0], group_key[1])
+                group.clear()
+
+        for raw in records:
+            d = json.loads(raw.decode())
+            key = (d["a"], d["c"])
+            if key != group_key:
+                flush()
+                group_key = key
+            group.append(event_from_api_dict(d["e"]))
+        flush()
+
+    async def _drain_loop(self) -> None:
+        assert self._wake is not None
+        while not self._closing:
+            if self.journal.lag == 0:
+                self._wake.clear()
+                if self.journal.lag == 0:  # re-check: append may have raced
+                    await self._wake.wait()
+                continue
+            now = time.monotonic()
+            if not self._breaker_allows(now):
+                await asyncio.sleep(
+                    min(0.2, max(0.01, self.breaker_reset_s / 10)))
+                continue
+            ok = await self._drain_once()
+            if not ok:
+                backoff = min(self.backoff_cap_s, self.backoff_base_s *
+                              (2 ** min(self._consecutive_failures, 8)))
+                # full jitter, same rationale as the feedback retries
+                await asyncio.sleep(backoff * (0.5 + random.random() / 2))
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self) -> None:
+        """Startup replay, then the background drainer. Replay pushes
+        every record left by the previous process BEFORE the server takes
+        traffic; if the backend is down the server starts anyway — new
+        events ack into the journal behind the old ones, order intact."""
+        self._wake = asyncio.Event()
+        replayed = 0
+        while self.journal.lag > 0:
+            before = self.journal.lag
+            if not await self._drain_once():
+                log.warning(
+                    "startup replay deferred (%d records pending): backend "
+                    "unreachable (%s); draining in background",
+                    self.journal.lag, self._last_error)
+                break
+            replayed += before - self.journal.lag
+        if replayed:
+            log.info("startup replay: %d journaled records pushed", replayed)
+        self._task = asyncio.create_task(self._drain_loop())
+
+    async def aclose(self) -> None:
+        """Stop the drainer and close the journal (final fsync). Undrained
+        records stay on disk for the next start's replay. Idempotent."""
+        self._closing = True
+        if self._task is not None:
+            if self._wake is not None:
+                self._wake.set()
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, Exception):  # noqa: BLE001
+                pass
+            self._task = None
+        await asyncio.to_thread(self.journal.close)
+
+    # -- surfaces ----------------------------------------------------------
+    @property
+    def degraded(self) -> bool:
+        """The backend push path is failing (breaker not closed). Acks
+        still flow — degraded, not down."""
+        return self._state != "closed"
+
+    def stats(self) -> dict:
+        return {
+            "journal": self.journal.stats(),
+            "drain": {
+                "breakerState": self._state,
+                "breakerOpens": self.breaker_opens,
+                "consecutiveFailures": self._consecutive_failures,
+                "failures": self.drain_failures,
+                "drainedBatches": self.drained_batches,
+                "lastError": self._last_error,
+            },
+        }
+
+    def health(self) -> dict:
+        """The event server's /health.json body (engine-server parity:
+        status/live/ready + the why)."""
+        j = self.journal.stats()
+        return {
+            "status": "degraded" if self.degraded else "ok",
+            "live": True,
+            "ready": True,
+            "journal": {
+                "lag": j["lag"],
+                "sizeBytes": j["sizeBytes"],
+                "maxBytes": j["maxBytes"],
+                "unsyncedBytes": j["unsyncedBytes"],
+                "fsyncPolicy": j["fsyncPolicy"],
+            },
+            "drain": {
+                "breakerState": self._state,
+                "breakerOpens": self.breaker_opens,
+                "consecutiveFailures": self._consecutive_failures,
+                "lastError": self._last_error,
+            },
+        }

@@ -18,8 +18,11 @@ from .event import (
     event_to_json,
     validate_event,
 )
-from .events_base import ANY, EventBackend, EventQuery, StorageError
+from .events_base import ANY, EventBackend, EventQuery, StorageError, TableNotInitialized
 from .frame import EventFrame, Ratings
+# NOTE: .journal is intentionally NOT imported here — it fires chaos
+# sites through workflow.faults, and workflow imports this package.
+# Import it as `predictionio_tpu.storage.journal` (the api layer does).
 from .memory import MemoryEvents
 from .partition import entity_key, hash64, iter_host_shard, partition_events, shard_of
 from .metadata import (
@@ -38,9 +41,10 @@ from .sqlite import SQLiteEvents
 __all__ = [
     "ANY", "AccessKey", "App", "BiMap", "Channel", "DataMap", "DataMapError",
     "EngineInstance", "EngineManifest", "EvaluationInstance", "Event",
-    "EventBackend", "EventFrame", "EventOp", "EventQuery", "MemoryEvents",
-    "MetadataStore", "Model", "PropertyMap", "Ratings", "SPECIAL_EVENTS",
-    "SQLiteEvents", "Storage", "StorageError", "ValidationError",
+    "EventBackend", "EventFrame", "EventOp", "EventQuery",
+    "MemoryEvents", "MetadataStore", "Model", "PropertyMap",
+    "Ratings", "SPECIAL_EVENTS", "SQLiteEvents", "Storage", "StorageError",
+    "TableNotInitialized", "ValidationError",
     "aggregate_properties", "aggregate_properties_single",
     "event_from_api_dict", "event_from_json", "event_to_api_dict",
     "entity_key", "hash64", "iter_host_shard", "partition_events", "shard_of",

@@ -33,11 +33,19 @@ from .datamap import PropertyMap
 from .event import Event
 from .frame import EventFrame
 
-__all__ = ["ANY", "EventBackend", "EventQuery", "StorageError"]
+__all__ = ["ANY", "EventBackend", "EventQuery", "StorageError",
+           "TableNotInitialized"]
 
 
 class StorageError(RuntimeError):
     pass
+
+
+class TableNotInitialized(StorageError):
+    """The per-app events table was never ``init_app``'d — the one
+    storage failure that legitimately reads as 404 on the API's read and
+    delete paths. Every other ``StorageError`` is a real backend fault
+    and must surface as 500, not masquerade as "Not Found"."""
 
 
 class _Any:

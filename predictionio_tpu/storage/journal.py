@@ -1,0 +1,435 @@
+"""Segmented append-only event journal — the ingestion write-ahead log.
+
+The reference's HBase event backend gave ingestion a real WAL for free
+(every `put` lands in the RegionServer's HLog before it is acked); the
+sqlite/memory backends here have nothing between "201 sent" and "row
+committed", so a storage outage turns every POST into a 500 and a crash
+loses whatever was in flight. This module restores the missing layer:
+the event server appends each accepted event to this journal, fsyncs per
+its policy, and acks 201 — a background drainer then pushes journal
+records into the ``EventBackend`` at its own pace (api/ingest.py).
+
+Design (the classic single-writer log, cf. HLog / Kafka segment logs):
+
+- **Segments**: ``journal-<seq>.log`` files under one directory; the
+  active segment rotates at ``segment_max_bytes`` so drained history can
+  be garbage-collected file-at-a-time instead of compacted in place.
+- **Framing**: each record is ``<u32 length><u32 crc32(payload)>`` +
+  payload (little-endian). CRC + length make a torn write detectable.
+- **Torn-tail truncation**: a crash mid-append leaves a partial frame at
+  the tail. On open, every segment is scanned; the first invalid frame
+  truncates its segment there and drops any later segments — recovery
+  keeps the longest valid prefix, never a hole.
+- **Cursor**: the drainer's progress ``(segment, offset, index)`` is
+  persisted atomically (tmp + ``os.replace``) in ``cursor.json``;
+  segments wholly behind the cursor are deleted. After a crash the
+  drainer resumes from the last persisted cursor — records drained but
+  not yet cursored are re-pushed, which is safe because event ids are
+  assigned BEFORE journaling and both built-in backends upsert by id
+  (``INSERT OR REPLACE``): replay is idempotent.
+- **fsync policy**: ``always`` (fsync inside every append), ``batch``
+  (the caller fsyncs once per ingest request via ``sync()`` before
+  acking), ``never`` (leave durability to the OS page cache — survives a
+  process crash, not a power cut).
+- **Backpressure**: past ``max_bytes`` of un-collected segments,
+  ``append`` raises ``JournalFull`` — the server turns that into 503 +
+  ``Retry-After`` instead of silently dropping events.
+
+Chaos sites: ``journal.append`` fires at the head of every append and
+``journal.fsync`` before every fsync (workflow/faults.py), so disk-level
+failures are provable in tests without a broken disk.
+
+Thread-safety: one lock around all mutation; appends come from the event
+server's ``asyncio.to_thread`` workers while the drainer reads/advances
+from its own thread.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import struct
+import threading
+import zlib
+from pathlib import Path
+
+from ..workflow.faults import FAULTS
+
+log = logging.getLogger("predictionio_tpu.journal")
+
+__all__ = ["EventJournal", "JournalFull", "FSYNC_POLICIES"]
+
+_HEADER = struct.Struct("<II")  # (payload length, crc32(payload))
+_SEGMENT_GLOB = "journal-*.log"
+_CURSOR_FILE = "cursor.json"
+
+FSYNC_POLICIES = ("always", "batch", "never")
+
+
+class JournalFull(RuntimeError):
+    """The journal hit ``max_bytes`` of undrained data — the caller must
+    shed load (503 + Retry-After) instead of dropping the event."""
+
+
+def _segment_name(seq: int) -> str:
+    return f"journal-{seq:08d}.log"
+
+
+def _segment_seq(path: Path) -> int:
+    return int(path.name[len("journal-"):-len(".log")])
+
+
+class _Segment:
+    """One on-disk segment: its seq, path, logical size and record count.
+
+    ``size`` is the VALID byte length (post torn-tail truncation) — the
+    reader never reads past it, the writer only appends at it."""
+
+    __slots__ = ("seq", "path", "size", "records")
+
+    def __init__(self, seq: int, path: Path, size: int = 0, records: int = 0):
+        self.seq = seq
+        self.path = path
+        self.size = size
+        self.records = records
+
+
+class EventJournal:
+    """Crash-safe append-only record log with a persisted drain cursor."""
+
+    def __init__(
+        self,
+        directory: str | os.PathLike,
+        *,
+        fsync: str = "batch",
+        max_bytes: int = 256 * 1024 * 1024,
+        segment_max_bytes: int = 16 * 1024 * 1024,
+    ):
+        if fsync not in FSYNC_POLICIES:
+            raise ValueError(
+                f"fsync policy must be one of {FSYNC_POLICIES}, got {fsync!r}")
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.fsync_policy = fsync
+        self.max_bytes = max(1, int(max_bytes))
+        self.segment_max_bytes = max(1, int(segment_max_bytes))
+        self._lock = threading.Lock()
+        self._closed = False
+        self._segments: list[_Segment] = []
+        self._write_fh = None  # open append handle on the LAST segment
+        # drain cursor: next record to hand the drainer
+        self._drain_seq = 0
+        self._drain_off = 0
+        self._drain_idx = 0  # monotonically increasing global record index
+        self._undrained = 0
+        # counters (stats()/health surfaces)
+        self.appended = 0          # records appended this process
+        self.drained = 0           # records acked past the cursor this process
+        self.synced = 0            # fsync calls
+        self.unsynced_bytes = 0    # bytes appended since the last fsync
+        self.truncated_bytes = 0   # torn-tail bytes dropped at open
+        self.rotations = 0
+        self.segments_removed = 0
+        self._recover()
+
+    # -- recovery ----------------------------------------------------------
+    def _recover(self) -> None:
+        """Scan segments, truncate the torn tail, load the cursor, GC
+        fully-drained history."""
+        paths = sorted(self.dir.glob(_SEGMENT_GLOB), key=_segment_seq)
+        torn = False
+        for path in paths:
+            if torn:
+                # a bad frame invalidates everything after it: keep the
+                # longest valid prefix, never a prefix with a hole
+                log.warning("journal: dropping segment %s after torn tail",
+                            path.name)
+                self.truncated_bytes += path.stat().st_size
+                path.unlink()
+                continue
+            seg = _Segment(_segment_seq(path), path)
+            valid, records = self._scan_segment(path)
+            raw = path.stat().st_size
+            if valid < raw:
+                log.warning(
+                    "journal: truncating torn tail of %s at %d (%d bytes "
+                    "dropped)", path.name, valid, raw - valid)
+                with open(path, "rb+") as fh:
+                    fh.truncate(valid)
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                self.truncated_bytes += raw - valid
+                torn = True
+            seg.size = valid
+            seg.records = records
+            self._segments.append(seg)
+        cursor = self._load_cursor()
+        if not self._segments:
+            # nothing on disk (fresh dir, or everything drained + GC'd
+            # before the restart): start one segment PAST the cursored
+            # one, so a stale in-segment cursor offset can never point
+            # beyond the new segment's records
+            seq = int(cursor.get("seq", -1)) + 1 if cursor else 0
+            self._open_segment(seq)
+            self._drain_idx = int(cursor.get("idx", 0)) if cursor else 0
+            self._drain_seq, self._drain_off = seq, 0
+            self._undrained = 0
+            return
+        # re-attach the append handle to the surviving tail segment
+        tail = self._segments[-1]
+        self._write_fh = open(tail.path, "ab")
+        if cursor:
+            self._drain_idx = int(cursor.get("idx", 0))
+            seq = int(cursor.get("seq", 0))
+            off = int(cursor.get("off", 0))
+            known = {s.seq: s for s in self._segments}
+            if seq in known:
+                # a torn tail can shrink the cursored segment underneath a
+                # cursor persisted before the crash — clamp, re-push
+                self._drain_seq = seq
+                self._drain_off = min(off, known[seq].size)
+            else:
+                # cursored segment already collected (or never synced):
+                # restart at the oldest surviving record; replay is
+                # idempotent so over-pushing is safe, holes are not
+                self._drain_seq = self._segments[0].seq
+                self._drain_off = 0
+        else:
+            self._drain_seq = self._segments[0].seq
+            self._drain_off = 0
+        self._undrained = self._count_from(self._drain_seq, self._drain_off)
+        self._gc_locked()
+
+    @staticmethod
+    def _scan_segment(path: Path) -> tuple[int, int]:
+        """Return (valid byte length, record count) of the longest valid
+        record prefix of ``path``."""
+        valid = 0
+        records = 0
+        with open(path, "rb") as fh:
+            while True:
+                header = fh.read(_HEADER.size)
+                if len(header) < _HEADER.size:
+                    break
+                length, crc = _HEADER.unpack(header)
+                payload = fh.read(length)
+                if len(payload) < length or zlib.crc32(payload) != crc:
+                    break
+                valid += _HEADER.size + length
+                records += 1
+        return valid, records
+
+    def _count_from(self, seq: int, off: int) -> int:
+        """Records at/after (seq, off) — the restart lag. Counted by
+        re-reading the partial segment once at open; later bookkeeping is
+        incremental."""
+        n = 0
+        for seg in self._segments:
+            if seg.seq < seq:
+                continue
+            if seg.seq > seq or off == 0:
+                n += seg.records
+                continue
+            with open(seg.path, "rb") as fh:
+                fh.seek(off)
+                while True:
+                    header = fh.read(_HEADER.size)
+                    if len(header) < _HEADER.size:
+                        break
+                    length, _ = _HEADER.unpack(header)
+                    fh.seek(length, os.SEEK_CUR)
+                    n += 1
+        return n
+
+    # -- cursor ------------------------------------------------------------
+    def _cursor_path(self) -> Path:
+        return self.dir / _CURSOR_FILE
+
+    def _load_cursor(self) -> dict | None:
+        try:
+            return json.loads(self._cursor_path().read_text())
+        except FileNotFoundError:
+            return None
+        except (json.JSONDecodeError, ValueError, OSError) as e:
+            # a torn cursor write lost the file content: restart from the
+            # oldest record (idempotent replay), never fail open
+            log.warning("journal: unreadable cursor (%s); replaying from "
+                        "the oldest record", e)
+            return None
+
+    def _persist_cursor_locked(self) -> None:
+        tmp = self._cursor_path().with_suffix(".tmp")
+        payload = json.dumps({"seq": self._drain_seq, "off": self._drain_off,
+                              "idx": self._drain_idx})
+        with open(tmp, "w") as fh:
+            fh.write(payload)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self._cursor_path())
+
+    # -- write path --------------------------------------------------------
+    def _open_segment(self, seq: int) -> None:
+        if self._write_fh is not None:
+            self._write_fh.close()
+        seg = _Segment(seq, self.dir / _segment_name(seq))
+        self._write_fh = open(seg.path, "ab")
+        seg.size = self._write_fh.tell()
+        self._segments.append(seg)
+
+    def _check_closed(self) -> None:
+        if self._closed:
+            raise RuntimeError("EventJournal is closed")
+
+    def append(self, payload: bytes) -> int:
+        """Durably frame one record; returns its global index. Raises
+        ``JournalFull`` past ``max_bytes`` of un-collected data (the
+        record is NOT written). With policy ``always`` the record is
+        fsynced before return; with ``batch`` the caller must ``sync()``
+        before acking."""
+        FAULTS.fire("journal.append")
+        frame = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
+        with self._lock:
+            self._check_closed()
+            if self.size_bytes() + len(frame) > self.max_bytes:
+                raise JournalFull(
+                    f"journal at capacity ({self.size_bytes()} of "
+                    f"{self.max_bytes} bytes undrained)")
+            tail = self._segments[-1]
+            if tail.size >= self.segment_max_bytes:
+                self._sync_locked()  # a rotated-away segment is immutable
+                self._open_segment(tail.seq + 1)
+                self.rotations += 1
+                tail = self._segments[-1]
+            self._write_fh.write(frame)
+            # flush to the OS so the drainer's read handle sees the bytes;
+            # fsync (durability) is the policy's business
+            self._write_fh.flush()
+            tail.size += len(frame)
+            tail.records += 1
+            self.appended += 1
+            self._undrained += 1
+            self.unsynced_bytes += len(frame)
+            idx = self._drain_idx + self._undrained - 1
+            if self.fsync_policy == "always":
+                self._sync_locked()
+            return idx
+
+    def sync(self) -> None:
+        """fsync the active segment (no-op under policy ``never`` — the
+        operator chose page-cache durability)."""
+        with self._lock:
+            self._check_closed()
+            if self.fsync_policy != "never":
+                self._sync_locked()
+
+    def _sync_locked(self) -> None:
+        if self.unsynced_bytes == 0 or self._write_fh is None:
+            return
+        FAULTS.fire("journal.fsync")
+        self._write_fh.flush()
+        os.fsync(self._write_fh.fileno())
+        self.synced += 1
+        self.unsynced_bytes = 0
+
+    # -- drain path --------------------------------------------------------
+    def peek_batch(self, max_records: int) -> tuple[list[bytes], tuple[int, int, int]]:
+        """Up to ``max_records`` undrained payloads in append order, plus
+        the cursor position ``(seq, off, idx)`` to ``advance`` to once
+        they are safely in the backend. Does not move the cursor."""
+        out: list[bytes] = []
+        with self._lock:
+            self._check_closed()
+            seq, off = self._drain_seq, self._drain_off
+            by_seq = {s.seq: s for s in self._segments}
+            while len(out) < max_records:
+                seg = by_seq.get(seq)
+                if seg is None or off >= seg.size:
+                    nxt = min((s.seq for s in self._segments if s.seq > seq),
+                              default=None)
+                    if nxt is None:
+                        break
+                    seq, off = nxt, 0
+                    continue
+                with open(seg.path, "rb") as fh:
+                    fh.seek(off)
+                    while len(out) < max_records and off < seg.size:
+                        header = fh.read(_HEADER.size)
+                        length, _ = _HEADER.unpack(header)
+                        out.append(fh.read(length))
+                        off += _HEADER.size + length
+            return out, (seq, off, self._drain_idx + len(out))
+
+    def advance(self, pos: tuple[int, int, int]) -> None:
+        """Persist the drain cursor at ``pos`` and GC segments wholly
+        behind it. Called only after the backend accepted the batch."""
+        seq, off, idx = pos
+        with self._lock:
+            self._check_closed()
+            self.drained += idx - self._drain_idx
+            self._undrained -= idx - self._drain_idx
+            self._drain_seq, self._drain_off, self._drain_idx = seq, off, idx
+            self._persist_cursor_locked()
+            self._gc_locked()
+
+    def _gc_locked(self) -> None:
+        keep: list[_Segment] = []
+        for seg in self._segments:
+            # the active (last) segment is never deleted — the writer
+            # holds it open and new appends land there
+            if seg.seq < self._drain_seq and seg is not self._segments[-1]:
+                try:
+                    seg.path.unlink()
+                except OSError:
+                    keep.append(seg)
+                    continue
+                self.segments_removed += 1
+            else:
+                keep.append(seg)
+        self._segments = keep
+
+    # -- introspection -----------------------------------------------------
+    def size_bytes(self) -> int:
+        """On-disk bytes across live segments (the backpressure gauge)."""
+        return sum(s.size for s in self._segments)
+
+    @property
+    def lag(self) -> int:
+        """Undrained record count — 0 means every acked event is in the
+        backend."""
+        with self._lock:
+            return self._undrained
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "lag": self._undrained,
+                "sizeBytes": sum(s.size for s in self._segments),
+                "maxBytes": self.max_bytes,
+                "segments": len(self._segments),
+                "appended": self.appended,
+                "drained": self.drained,
+                "drainIndex": self._drain_idx,
+                "fsyncPolicy": self.fsync_policy,
+                "fsyncs": self.synced,
+                "unsyncedBytes": self.unsynced_bytes,
+                "truncatedBytes": self.truncated_bytes,
+                "rotations": self.rotations,
+                "segmentsRemoved": self.segments_removed,
+            }
+
+    def close(self) -> None:
+        """Final fsync (unless policy ``never``) and handle close.
+        Idempotent."""
+        with self._lock:
+            if self._closed:
+                return
+            if self.fsync_policy != "never":
+                try:
+                    self._sync_locked()
+                except Exception:  # noqa: BLE001 — closing regardless
+                    log.exception("journal: final fsync failed")
+            if self._write_fh is not None:
+                self._write_fh.close()
+                self._write_fh = None
+            self._closed = True

@@ -17,7 +17,7 @@ import uuid
 from typing import Iterator
 
 from .event import Event
-from .events_base import EventBackend, EventQuery, StorageError
+from .events_base import EventBackend, EventQuery, TableNotInitialized
 
 __all__ = ["MemoryEvents"]
 
@@ -45,7 +45,7 @@ class MemoryEvents(EventBackend):
             t = self._tables.get(key)
             if t is None:
                 if not create:
-                    raise StorageError(
+                    raise TableNotInitialized(
                         f"events table for app {app_id} channel {channel_id} "
                         "not initialized (run init_app / `pio app new`)"
                     )

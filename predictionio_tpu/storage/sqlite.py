@@ -29,7 +29,7 @@ from ._sqlite_util import LockedConnection
 from .datamap import DataMap
 from .event import Event
 from .frame import EventFrame
-from .events_base import ANY, EventBackend, EventQuery, StorageError
+from .events_base import ANY, EventBackend, EventQuery, StorageError, TableNotInitialized
 
 __all__ = ["SQLiteEvents"]
 
@@ -74,15 +74,27 @@ class SQLiteEvents(EventBackend):
         self._known_tables: set[str] = set()
         self._seq = 0
 
-    def _conn(self) -> sqlite3.Connection:
+    def _raise_if_closed(self) -> None:
         if self._closed:
             raise RuntimeError(f"{type(self).__name__} is closed")
+
+    def _conn(self) -> sqlite3.Connection:
+        self._raise_if_closed()
         if self._shared is not None:
             return self._shared
         conn = getattr(self._local, "conn", None)
         if conn is None:
-            conn = sqlite3.connect(self._path, timeout=30.0)
+            # check_same_thread=False so close() can REALLY close every
+            # thread's connection (each conn is still used by one thread;
+            # writes additionally serialize under self._lock) — otherwise
+            # worker conns dangle open past close() and leak the file
+            # handle until thread exit
+            conn = sqlite3.connect(self._path, timeout=30.0,
+                                   check_same_thread=False)
             with self._lock:
+                if self._closed:  # close() raced us: do not leak a conn
+                    conn.close()
+                    self._raise_if_closed()
                 self._all_conns.append(conn)
             conn.execute("PRAGMA journal_mode=WAL")
             conn.execute("PRAGMA synchronous=NORMAL")
@@ -99,7 +111,7 @@ class SQLiteEvents(EventBackend):
         ).fetchone()
         if row is None:
             if not create:
-                raise StorageError(
+                raise TableNotInitialized(
                     f"events table for app {app_id} channel {channel_id} "
                     "not initialized (run init_app / `pio app new`)"
                 )
@@ -129,18 +141,22 @@ class SQLiteEvents(EventBackend):
         return True
 
     def close(self) -> None:
-        self._closed = True
+        """Close every thread's connection. Post-close use on ANY thread
+        — including a find() iterator already mid-flight — surfaces as
+        the "is closed" RuntimeError via the ``_closed`` guard, never a
+        raw ``sqlite3.ProgrammingError`` over a dangling handle."""
         with self._lock:
+            self._closed = True
             for conn in self._all_conns:
                 try:
                     conn.close()
                 except sqlite3.ProgrammingError:
-                    pass  # a conn owned by a live worker thread; dropped at exit
+                    pass  # mid-statement on another thread; GC'd at exit
             self._all_conns.clear()
-        self._local.conn = None
-        if self._shared is not None:
-            self._shared.close()
-            self._shared = None
+            self._local.conn = None
+            if self._shared is not None:
+                self._shared.close()
+                self._shared = None
 
     # -- writes -----------------------------------------------------------
     def _row(self, e: Event) -> tuple:
@@ -217,9 +233,13 @@ class SQLiteEvents(EventBackend):
 
     def get(self, event_id: str, app_id: int, channel_id: int | None = None) -> Event | None:
         table = self._ensure_table(app_id, channel_id, create=False)
-        row = self._conn().execute(
-            f"SELECT * FROM {table} WHERE event_id=?", (event_id,)
-        ).fetchone()
+        try:
+            row = self._conn().execute(
+                f"SELECT * FROM {table} WHERE event_id=?", (event_id,)
+            ).fetchone()
+        except sqlite3.ProgrammingError:
+            self._raise_if_closed()  # close() raced us mid-statement
+            raise
         return self._from_row(row) if row else None
 
     def delete(self, event_id: str, app_id: int, channel_id: int | None = None) -> bool:
@@ -289,7 +309,22 @@ class SQLiteEvents(EventBackend):
         sql = f"SELECT * FROM {table}{where} ORDER BY event_time {order}, seq {order}"
         if query.limit is not None and query.limit >= 0:
             sql += f" LIMIT {int(query.limit)}"
-        for row in self._conn().execute(sql, params):
+        # the lazy cursor iterates across yields; close() can land between
+        # them, and its intended signal is the _closed RuntimeError — not a
+        # raw sqlite3.ProgrammingError off the dead cursor
+        try:
+            rows = iter(self._conn().execute(sql, params))
+        except sqlite3.ProgrammingError:
+            self._raise_if_closed()
+            raise
+        while True:
+            try:
+                row = next(rows)
+            except StopIteration:
+                return
+            except sqlite3.ProgrammingError:
+                self._raise_if_closed()
+                raise
             yield self._from_row(row)
 
     def find_frame(self, query: EventQuery):

@@ -14,7 +14,8 @@ console/Console.scala:128-1245). Same verb set, no JVM/spark-submit spawning
   pio batchpredict --input queries.jsonl --output predictions.jsonl
   pio bench serve [--ways 1,2,4,8]
   pio undeploy [--port 8000]
-  pio eventserver [--port 7070] [--stats]
+  pio eventserver [--port 7070] [--stats] [--journal-dir D]
+                  [--journal-fsync always|batch|never] [--journal-max-mb N]
   pio adminserver [--port 7071]
   pio dashboard [--port 9000]
   pio import|export --appid N --input|--output FILE
@@ -575,7 +576,10 @@ def cmd_undeploy(args) -> int:
 def cmd_eventserver(args) -> int:
     from ..api import run_event_server
 
-    run_event_server(ip=args.ip, port=args.port, stats=args.stats)
+    run_event_server(ip=args.ip, port=args.port, stats=args.stats,
+                     journal_dir=args.journal_dir,
+                     journal_fsync=args.journal_fsync,
+                     journal_max_mb=args.journal_max_mb)
     return 0
 
 
@@ -793,6 +797,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--ip", default="0.0.0.0")
     sp.add_argument("--port", type=int, default=7070)
     sp.add_argument("--stats", action="store_true")
+    sp.add_argument("--journal-dir", default=None,
+                    help="enable durable ingestion: write-ahead journal "
+                         "directory (events ack 201 after a durable "
+                         "append; a background drainer feeds the backend)")
+    sp.add_argument("--journal-fsync", default="batch",
+                    choices=["always", "batch", "never"],
+                    help="journal fsync policy: per-record, per-request "
+                         "(default), or OS page cache")
+    sp.add_argument("--journal-max-mb", type=int, default=256,
+                    help="journal capacity; past it ingestion answers "
+                         "503 + Retry-After (backpressure, default 256)")
 
     sp = sub.add_parser("adminserver")
     sp.add_argument("--ip", default="127.0.0.1")

@@ -21,7 +21,16 @@ Sites instrumented in this repo:
   ``FeedbackPublisher`` (async site)
 - ``eventserver.insert``    — inside the event-store write path of
   ``POST /events.json`` (async site; arm a ``StorageError`` to exercise
-  the 500/stats path without a broken backend)
+  the 500/stats path without a broken backend; direct mode only — with a
+  journal the write path never touches the backend inline)
+- ``journal.append``        — head of ``EventJournal.append`` (sync
+  site; an ``error`` is a failing disk → the API answers 500)
+- ``journal.fsync``         — before each journal ``os.fsync`` (sync
+  site; fires under the journal lock, so a hang models a hung disk
+  stalling ingestion)
+- ``eventserver.drain``     — before each drainer push of journaled
+  records into the backend (async site; arm an un-bounded ``error`` for
+  a hard storage outage the 201 acks must survive)
 
 A fault is armed per site with a kind:
 

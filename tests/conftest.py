@@ -40,6 +40,11 @@ def pytest_configure(config):
         "chaos: fault-injection resilience tests (CPU-fast, deterministic "
         "via predictionio_tpu.workflow.faults; guarded by a per-test "
         "SIGALRM timeout so an injected hang cannot wedge the suite)")
+    config.addinivalue_line(
+        "markers",
+        "ingest: durable event-ingestion tests (the write-ahead journal, "
+        "drainer and backpressure surfaces — test_journal.py and "
+        "test_ingest_durability.py); select with -m ingest")
 
 
 #: Hard per-test budget for chaos tests. Injected hangs are capped at

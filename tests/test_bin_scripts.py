@@ -88,3 +88,17 @@ def test_pio_dispatcher_version(tmp_path):
     from predictionio_tpu import __version__
 
     assert __version__ in out.stdout
+
+
+def test_pio_eventserver_help_documents_journal_flags(tmp_path):
+    """The durability knobs are part of the operator surface: `pio
+    eventserver --help` must advertise the journal flags and every fsync
+    policy choice, so the docs/operations.md runbook stays honest."""
+    env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
+    out = subprocess.run([str(REPO / "bin" / "pio"), "eventserver", "--help"],
+                         capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    for flag in ("--journal-dir", "--journal-fsync", "--journal-max-mb"):
+        assert flag in out.stdout, f"{flag} missing from eventserver --help"
+    for policy in ("always", "batch", "never"):
+        assert policy in out.stdout

@@ -296,6 +296,76 @@ def test_batch_atomicity_contract(server, app_key):
     assert [x["status"] for x in r.json()] == [201, 201, 201]
 
 
+def test_read_paths_disambiguate_missing_table_from_outage(server):
+    """404 is reserved for "this app has no events table" — a REAL
+    storage failure on the read/delete paths must surface as 500, or a
+    backend outage reads as an empty app to every dashboard client."""
+    import unittest.mock as mock
+
+    from predictionio_tpu.storage.events_base import TableNotInitialized
+
+    meta = Storage.get_metadata()
+    app = meta.app_insert("noinit")
+    key = meta.access_key_insert(app.id).key  # init_app never called
+
+    # uninitialized table: legitimately Not Found on every read path
+    assert requests.get(
+        f"{server.url}/events.json?accessKey={key}").status_code == 404
+    assert requests.get(
+        f"{server.url}/events/x.json?accessKey={key}").status_code == 404
+    assert requests.delete(
+        f"{server.url}/events/x.json?accessKey={key}").status_code == 404
+
+    Storage.get_events().init_app(app.id)
+    dao_type = type(Storage.get_events())
+    boom = StorageError("backend down")
+    with mock.patch.object(dao_type, "find", side_effect=boom):
+        r = requests.get(f"{server.url}/events.json?accessKey={key}")
+        assert r.status_code == 500 and "backend down" in r.json()["message"]
+    with mock.patch.object(dao_type, "get", side_effect=boom):
+        r = requests.get(f"{server.url}/events/x.json?accessKey={key}")
+        assert r.status_code == 500
+    with mock.patch.object(dao_type, "delete", side_effect=boom):
+        r = requests.delete(f"{server.url}/events/x.json?accessKey={key}")
+        assert r.status_code == 500
+    # and the subclass relationship keeps generic handlers working
+    assert issubclass(TableNotInitialized, StorageError)
+
+
+def test_batch_non_atomic_mid_failure_statuses_exact(server, app_key):
+    """On a non-atomic backend a mid-batch failure yields mixed per-row
+    statuses IN ORDER — the rows that landed say 201, the failed one says
+    500, later rows still insert. (The atomic all-or-nothing contract is
+    pinned in test_batch_atomicity_contract.)"""
+    import unittest.mock as mock
+
+    _, key = app_key
+    events_dao = Storage.get_events()
+    batch = [dict(EV, entityId=f"na{i}") for i in range(3)]
+    with mock.patch.object(type(events_dao), "BATCH_ATOMIC", False), \
+         mock.patch.object(
+             type(events_dao), "insert",
+             side_effect=["id-a", StorageError("disk full"), "id-c"]):
+        r = requests.post(
+            f"{server.url}/batch/events.json?accessKey={key}", json=batch)
+    assert r.status_code == 200
+    rows = r.json()
+    assert [x["status"] for x in rows] == [201, 500, 201]
+    assert rows[0]["eventId"] == "id-a" and rows[2]["eventId"] == "id-c"
+    assert "disk full" in rows[1]["message"]
+    body = requests.get(f"{server.url}/stats.json?accessKey={key}").json()
+    assert body["statusCount"] == {"201": 2, "500": 1}
+
+
+def test_health_endpoint_without_journal(server):
+    """/health.json needs no access key (load balancers probe it) and
+    reports a journal-less server as plainly ok."""
+    r = requests.get(f"{server.url}/health.json")
+    assert r.status_code == 200
+    assert r.json() == {"status": "ok", "live": True, "ready": True,
+                        "journal": None, "drain": None}
+
+
 def test_stats_books_every_request_status(server, app_key):
     """/stats.json books the ACTUAL status of every ingest outcome — 201
     accepts, 400 malformed/invalid, 401 bad channel, 403 key-scope

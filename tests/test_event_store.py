@@ -233,6 +233,60 @@ def test_sqlite_batch_failure_persists_nothing(tmp_path):
     be.close()
 
 
+def test_sqlite_close_fails_other_threads_cleanly(tmp_path):
+    """close() must present EVERY thread's next use — including handles
+    cached in other threads and half-consumed cursors — as the intended
+    "is closed" RuntimeError, not a raw sqlite3.ProgrammingError leaking
+    from whichever connection object happened to die first."""
+    import threading
+
+    from predictionio_tpu.storage.sqlite import SQLiteEvents
+
+    be = SQLiteEvents({"path": str(tmp_path / "close.db")})
+    be.init_app(APP)
+    for m in range(3):
+        be.insert(mk(eid=f"u{m}", minutes=m), APP)
+
+    # a worker thread warms its own per-thread connection...
+    warmed = threading.Event()
+    proceed = threading.Event()
+    outcome: list = []
+
+    def worker():
+        assert len(list(be.find(EventQuery(APP)))) == 3  # caches a conn
+        warmed.set()
+        proceed.wait(10)
+        try:
+            be.insert(mk(eid="late"), APP)
+            outcome.append("inserted")
+        except Exception as e:  # noqa: BLE001 — the type IS the assertion
+            outcome.append(e)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    assert warmed.wait(10)
+
+    # ...and the main thread closes mid-iteration of its own cursor
+    it = be.find(EventQuery(APP))
+    assert next(it) is not None
+    be.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        next(it)
+
+    proceed.set()
+    t.join(10)
+    assert len(outcome) == 1
+    assert isinstance(outcome[0], RuntimeError)
+    assert "closed" in str(outcome[0])
+
+    # every post-close entry point reports the same way
+    with pytest.raises(RuntimeError, match="closed"):
+        be.get("nope", APP)
+    with pytest.raises(RuntimeError, match="closed"):
+        list(be.find(EventQuery(APP)))
+    be.close()  # idempotent
+
+
 def test_remove_before_trims_by_time(backend):
     """Time-windowed trim (`pio app data-delete --before` backing verb,
     the role of the reference's trim-app engine): events strictly older

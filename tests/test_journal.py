@@ -1,0 +1,258 @@
+"""EventJournal — the ingestion write-ahead log (storage/journal.py).
+
+Pure file-level contract tests: framing, torn-tail recovery, cursor
+persistence, rotation + GC, capacity backpressure and the fsync
+policies. The HTTP-level durability story (acks surviving a backend
+outage and a process kill) lives in test_ingest_durability.py.
+
+ResourceWarning is promoted to an error here: a journal that leaks an
+open segment handle would hold the WAL hostage across restarts.
+"""
+
+import pytest
+
+from predictionio_tpu.storage.journal import (
+    EventJournal,
+    JournalFull,
+)
+
+pytestmark = [
+    pytest.mark.ingest,
+    pytest.mark.filterwarnings("error::ResourceWarning"),
+]
+
+
+def p(i: int) -> bytes:
+    return f"payload-{i:04d}".encode()
+
+
+@pytest.fixture
+def jdir(tmp_path):
+    return tmp_path / "journal"
+
+
+def test_append_peek_advance_roundtrip(jdir):
+    j = EventJournal(jdir)
+    for i in range(5):
+        assert j.append(p(i)) == i
+    assert j.lag == 5
+
+    records, pos = j.peek_batch(3)
+    assert records == [p(0), p(1), p(2)]
+    assert j.lag == 5  # peek does not move the cursor
+    j.advance(pos)
+    assert j.lag == 2
+
+    records, pos = j.peek_batch(10)
+    assert records == [p(3), p(4)]
+    j.advance(pos)
+    assert j.lag == 0
+    assert j.peek_batch(10)[0] == []
+
+    s = j.stats()
+    assert s["appended"] == 5 and s["drained"] == 5 and s["drainIndex"] == 5
+    j.close()
+
+
+def test_reopen_resumes_from_persisted_cursor(jdir):
+    j = EventJournal(jdir)
+    for i in range(6):
+        j.append(p(i))
+    _, pos = j.peek_batch(4)
+    j.advance(pos)
+    j.close()
+
+    j2 = EventJournal(jdir)
+    assert j2.lag == 2
+    records, pos = j2.peek_batch(10)
+    assert records == [p(4), p(5)]
+    # global indices keep counting across the restart
+    assert pos[2] == 6
+    j2.close()
+
+
+def test_crash_without_advance_replays_everything(jdir):
+    j = EventJournal(jdir, fsync="always")
+    for i in range(5):
+        j.append(p(i))
+    j.close()  # no advance() ever ran — simulates a crash pre-drain
+
+    j2 = EventJournal(jdir)
+    assert j2.lag == 5
+    assert j2.peek_batch(10)[0] == [p(i) for i in range(5)]
+    j2.close()
+
+
+def test_torn_tail_truncated_on_open(jdir):
+    j = EventJournal(jdir)
+    for i in range(3):
+        j.append(p(i))
+    j.sync()
+    seg = next(j.dir.glob("journal-*.log"))
+    j.close()
+    # a crash mid-append: a frame header promising bytes that never landed
+    with open(seg, "ab") as fh:
+        fh.write(b"\xff\xff\x00\x00GARB")
+
+    j2 = EventJournal(jdir)
+    assert j2.stats()["truncatedBytes"] > 0
+    assert j2.lag == 3
+    assert j2.peek_batch(10)[0] == [p(i) for i in range(3)]
+    # the truncated tail is writable again — new appends frame cleanly
+    j2.append(p(99))
+    assert j2.peek_batch(10)[0][-1] == p(99)
+    j2.close()
+
+
+def test_corruption_drops_all_later_segments(jdir):
+    # tiny segments: every append rotates, so corruption lands mid-history
+    j = EventJournal(jdir, segment_max_bytes=1)
+    for i in range(4):
+        j.append(p(i))
+    j.sync()
+    segs = sorted(j.dir.glob("journal-*.log"))
+    assert len(segs) == 4
+    j.close()
+    # flip one payload byte in segment 1 -> CRC mismatch there
+    raw = bytearray(segs[1].read_bytes())
+    raw[-1] ^= 0xFF
+    segs[1].write_bytes(raw)
+
+    j2 = EventJournal(jdir)
+    # the longest valid prefix is record 0 alone: segment 1 truncates at
+    # its bad frame and segments 2..3 are dropped entirely — never a hole
+    assert j2.peek_batch(10)[0] == [p(0)]
+    assert j2.lag == 1
+    assert not segs[2].exists() and not segs[3].exists()
+    j2.close()
+
+
+def test_rotation_and_gc_behind_cursor(jdir):
+    j = EventJournal(jdir, segment_max_bytes=1)
+    for i in range(5):
+        j.append(p(i))
+    assert j.stats()["rotations"] == 4
+    _, pos = j.peek_batch(10)
+    j.advance(pos)
+    # drained segments are unlinked file-at-a-time; the active one stays
+    assert j.stats()["segmentsRemoved"] == 4
+    assert len(list(j.dir.glob("journal-*.log"))) == 1
+    # appends keep working after GC, indices still monotonic
+    assert j.append(p(5)) == 5
+    assert j.peek_batch(10)[0] == [p(5)]
+    j.close()
+
+
+def test_journal_full_backpressure_and_recovery(jdir):
+    j = EventJournal(jdir, max_bytes=256, segment_max_bytes=1)
+    appended = 0
+    with pytest.raises(JournalFull):
+        for i in range(100):
+            j.append(p(i))
+            appended += 1
+    assert 0 < appended < 100
+    assert j.lag == appended  # the failed append wrote nothing
+
+    # draining + GC frees capacity in whole segments -> appends resume
+    _, pos = j.peek_batch(1000)
+    j.advance(pos)
+    j.append(p(500))
+    assert j.peek_batch(10)[0] == [p(500)]
+    j.close()
+
+
+def test_fsync_policies(jdir):
+    with pytest.raises(ValueError):
+        EventJournal(jdir / "x", fsync="sometimes")
+
+    j = EventJournal(jdir / "always", fsync="always")
+    j.append(p(0))
+    assert j.stats()["fsyncs"] >= 1 and j.stats()["unsyncedBytes"] == 0
+    j.close()
+
+    j = EventJournal(jdir / "batch", fsync="batch")
+    j.append(p(0))
+    assert j.stats()["unsyncedBytes"] > 0
+    j.sync()
+    assert j.stats()["fsyncs"] == 1 and j.stats()["unsyncedBytes"] == 0
+    j.close()
+
+    j = EventJournal(jdir / "never", fsync="never")
+    j.append(p(0))
+    j.sync()  # no-op by operator choice
+    assert j.stats()["fsyncs"] == 0 and j.stats()["unsyncedBytes"] > 0
+    j.close()
+
+
+def test_close_is_idempotent_and_guards_use(jdir):
+    j = EventJournal(jdir)
+    j.append(p(0))
+    j.close()
+    j.close()
+    for op in (lambda: j.append(p(1)), lambda: j.sync(),
+               lambda: j.peek_batch(1), lambda: j.advance((0, 0, 1))):
+        with pytest.raises(RuntimeError, match="closed"):
+            op()
+
+
+def test_reopen_after_segments_vanish_respects_cursor(jdir):
+    j = EventJournal(jdir)
+    for i in range(3):
+        j.append(p(i))
+    _, pos = j.peek_batch(10)
+    j.advance(pos)
+    j.close()
+    for seg in jdir.glob("journal-*.log"):
+        seg.unlink()  # ops wiped drained history; cursor.json survives
+
+    j2 = EventJournal(jdir)
+    assert j2.lag == 0
+    # the fresh segment starts PAST the cursored one so the stale
+    # in-segment offset can never skip new records
+    assert j2.append(p(3)) == 3
+    assert j2.peek_batch(10)[0] == [p(3)]
+    j2.close()
+
+
+def test_unreadable_cursor_replays_from_oldest(jdir):
+    j = EventJournal(jdir)
+    for i in range(3):
+        j.append(p(i))
+    _, pos = j.peek_batch(2)
+    j.advance(pos)
+    j.close()
+    (jdir / "cursor.json").write_text("{torn")
+
+    # fail open, never fail closed: replay everything (idempotent by id)
+    j2 = EventJournal(jdir)
+    assert j2.lag == 3
+    assert j2.peek_batch(10)[0] == [p(i) for i in range(3)]
+    j2.close()
+
+
+@pytest.mark.chaos
+def test_append_fault_site(jdir):
+    from predictionio_tpu.workflow.faults import FAULTS, FaultInjected
+
+    j = EventJournal(jdir)
+    FAULTS.inject("journal.append", "error", times=1)
+    with pytest.raises(FaultInjected):
+        j.append(p(0))
+    assert j.lag == 0  # the failed append left no partial frame
+    assert j.append(p(1)) == 0
+    j.close()
+
+
+@pytest.mark.chaos
+def test_fsync_fault_site(jdir):
+    from predictionio_tpu.workflow.faults import FAULTS, FaultInjected
+
+    j = EventJournal(jdir, fsync="batch")
+    j.append(p(0))
+    FAULTS.inject("journal.fsync", "error", times=1)
+    with pytest.raises(FaultInjected):
+        j.sync()
+    FAULTS.clear()
+    j.sync()  # the retry fsyncs the still-pending bytes
+    assert j.stats()["unsyncedBytes"] == 0
+    j.close()
