@@ -48,6 +48,7 @@ from ..ops.neighbors import build_bilinear_layout
 from ..ops.retrieval import RetrievalServingMixin
 from ..storage.bimap import BiMap
 from ..storage.frame import Ratings
+from ..workflow.faults import FAULTS
 
 log = logging.getLogger("predictionio_tpu.als")
 
@@ -979,6 +980,9 @@ def train_als(ratings: Ratings, config: ALSConfig, mesh=None, *,
     u = None
     carry_u = u_restored if u_restored is not None else u_seed
     for it in range(start_it, config.iterations):
+        # chaos site: a preemption striking mid-training (arm with
+        # after=N to let N iterations — and their checkpoints — land)
+        FAULTS.fire("train.step")
         u, v = step(u_bk, i_bk, carry_u, v)
         carry_u = u
         done = it + 1

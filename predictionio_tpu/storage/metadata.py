@@ -74,7 +74,8 @@ def _utcnow() -> datetime:
 @dataclass(frozen=True)
 class EngineInstance:
     """One training/evaluation run's record (EngineInstances.scala:47-67).
-    Status lifecycle: INIT -> TRAINING -> COMPLETED | ABORTED."""
+    Status lifecycle: INIT -> TRAINING -> COMPLETED | ABORTED, plus
+    ABANDONED for stale-heartbeat orphans flipped by the reaper."""
     id: str = ""
     status: str = "INIT"
     start_time: datetime = field(default_factory=_utcnow)
@@ -95,6 +96,12 @@ class EngineInstance:
     evaluator_results: str = ""
     evaluator_results_html: str = ""
     evaluator_results_json: str = ""
+    #: UTC isoformat of the supervisor's last liveness stamp; empty until
+    #: the first heartbeat. Lets `pio status` / the reaper tell a live
+    #: INIT run from an orphan whose process died.
+    last_heartbeat: str = ""
+    #: supervised retry attempt currently running (0 = first attempt)
+    attempt: int = 0
 
 
 @dataclass(frozen=True)
@@ -116,9 +123,18 @@ class EvaluationInstance:
 
 @dataclass(frozen=True)
 class Model:
-    """Serialized model blob keyed by engine-instance id (Models.scala:30)."""
+    """Serialized model blob keyed by engine-instance id (Models.scala:30).
+    ``checksum`` is ``"sha256:<hex>"`` over ``models``; empty for blobs
+    written before integrity tracking (verification skips those)."""
     id: str
     models: bytes
+    checksum: str = ""
+
+    @staticmethod
+    def compute_checksum(blob: bytes) -> str:
+        import hashlib
+
+        return "sha256:" + hashlib.sha256(blob).hexdigest()
 
 
 _DT_FIELDS = {"start_time", "end_time"}
@@ -202,16 +218,32 @@ class MetadataStore:
                   id TEXT, version TEXT, doc TEXT, PRIMARY KEY (id, version));
                 CREATE TABLE IF NOT EXISTS engine_instances (
                   id TEXT PRIMARY KEY, status TEXT, engine_id TEXT,
-                  engine_version TEXT, engine_variant TEXT, start_time TEXT, doc TEXT);
+                  engine_version TEXT, engine_variant TEXT, start_time TEXT,
+                  last_heartbeat TEXT DEFAULT '', attempt INTEGER DEFAULT 0,
+                  doc TEXT);
                 CREATE TABLE IF NOT EXISTS evaluation_instances (
                   id TEXT PRIMARY KEY, status TEXT, start_time TEXT, doc TEXT);
                 CREATE TABLE IF NOT EXISTS models (
-                  id TEXT PRIMARY KEY, blob BLOB);
+                  id TEXT PRIMARY KEY, blob BLOB, checksum TEXT DEFAULT '');
                 CREATE TABLE IF NOT EXISTS sequences (
                   name TEXT PRIMARY KEY, value INTEGER);
                 """
             )
+            # Databases created before heartbeat/attempt/checksum existed
+            # migrate in place (ALTER TABLE ADD COLUMN is cheap and
+            # idempotent via the PRAGMA check).
+            self._add_missing_column(c, "engine_instances",
+                                     "last_heartbeat", "TEXT DEFAULT ''")
+            self._add_missing_column(c, "engine_instances",
+                                     "attempt", "INTEGER DEFAULT 0")
+            self._add_missing_column(c, "models", "checksum", "TEXT DEFAULT ''")
             c.commit()
+
+    @staticmethod
+    def _add_missing_column(c, table: str, column: str, decl: str) -> None:
+        cols = {r[1] for r in c.execute(f"PRAGMA table_info({table})")}
+        if column not in cols:
+            c.execute(f"ALTER TABLE {table} ADD COLUMN {column} {decl}")
 
     def close(self) -> None:
         self._closed = True
@@ -399,9 +431,13 @@ class MetadataStore:
         c = self._conn()
         with self._lock:
             c.execute(
-                "INSERT OR REPLACE INTO engine_instances VALUES (?,?,?,?,?,?,?)",
+                "INSERT OR REPLACE INTO engine_instances "
+                "(id, status, engine_id, engine_version, engine_variant, "
+                " start_time, last_heartbeat, attempt, doc) "
+                "VALUES (?,?,?,?,?,?,?,?,?)",
                 (i.id, i.status, i.engine_id, i.engine_version, i.engine_variant,
-                 _utc_sort_key(i.start_time), _ser(i)),
+                 _utc_sort_key(i.start_time), i.last_heartbeat, i.attempt,
+                 _ser(i)),
             )
             c.commit()
         return i.id
@@ -427,6 +463,16 @@ class MetadataStore:
             "engine_id=? AND engine_version=? AND engine_variant=? "
             "ORDER BY start_time DESC",
             (engine_id, engine_version, engine_variant),
+        )
+        return [_deser(EngineInstance, r[0]) for r in rows]
+
+    def engine_instance_get_by_status(self, status: str) -> list[EngineInstance]:
+        """All instances with ``status``, latest first — the reaper's scan
+        (status='INIT') and `pio status`'s live-run listing."""
+        rows = self._conn().execute(
+            "SELECT doc FROM engine_instances WHERE status=? "
+            "ORDER BY start_time DESC",
+            (status,),
         )
         return [_deser(EngineInstance, r[0]) for r in rows]
 
@@ -492,12 +538,18 @@ class MetadataStore:
     def model_insert(self, m: Model) -> None:
         c = self._conn()
         with self._lock:
-            c.execute("INSERT OR REPLACE INTO models VALUES (?, ?)", (m.id, m.models))
+            c.execute(
+                "INSERT OR REPLACE INTO models (id, blob, checksum) "
+                "VALUES (?, ?, ?)",
+                (m.id, m.models, m.checksum),
+            )
             c.commit()
 
     def model_get(self, id: str) -> Model | None:
-        row = self._conn().execute("SELECT blob FROM models WHERE id=?", (id,)).fetchone()
-        return Model(id=id, models=row[0]) if row else None
+        row = self._conn().execute(
+            "SELECT blob, checksum FROM models WHERE id=?", (id,)
+        ).fetchone()
+        return Model(id=id, models=row[0], checksum=row[1] or "") if row else None
 
     def model_delete(self, id: str) -> bool:
         c = self._conn()

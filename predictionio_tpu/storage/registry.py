@@ -45,15 +45,27 @@ class LocalFSModels:
 
     def insert(self, m: Model) -> None:
         (self._dir / m.id).write_bytes(m.models)
+        # integrity sidecar — the file-backed analog of the sqlite
+        # checksum column; absent for pre-integrity blobs
+        sidecar = self._dir / f"{m.id}.sha256"
+        if m.checksum:
+            sidecar.write_text(m.checksum)
+        elif sidecar.exists():
+            sidecar.unlink()
 
     def get(self, id: str) -> Model | None:
         p = self._dir / id
         if not p.exists():
             return None
-        return Model(id=id, models=p.read_bytes())
+        sidecar = self._dir / f"{id}.sha256"
+        checksum = sidecar.read_text().strip() if sidecar.exists() else ""
+        return Model(id=id, models=p.read_bytes(), checksum=checksum)
 
     def delete(self, id: str) -> bool:
         p = self._dir / id
+        sidecar = self._dir / f"{id}.sha256"
+        if sidecar.exists():
+            sidecar.unlink()
         if p.exists():
             p.unlink()
             return True

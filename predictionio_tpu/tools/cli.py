@@ -21,6 +21,7 @@ console/Console.scala:128-1245). Same verb set, no JVM/spark-submit spawning
   pio import|export --appid N --input|--output FILE
   pio template list|get
   pio status | version
+  pio admin reap [--stale-after-s N] [--dry-run]
 
 Engine directory convention (replacing the reference's sbt build + jar
 manifest): an engine dir holds ``engine.json`` whose ``engineFactory``
@@ -347,6 +348,9 @@ def cmd_train(args) -> int:
         engine_variant=variant_id,
         engine_factory=variant.get("engineFactory", ""),
         batch=args.batch,
+        max_retries=args.max_retries,
+        retry_backoff_s=args.retry_backoff_s,
+        train_budget_s=args.train_budget_s or None,
     )
     _ok(f"Training completed. Engine instance: {iid}")
     return 0
@@ -434,6 +438,9 @@ def cmd_deploy(args) -> int:
     run_engine_server(
         engine,
         inst,
+        # a pinned --engine-instance-id must fail loud; the default
+        # latest-COMPLETED pick may fall back past a corrupt blob
+        fallback=not args.engine_instance_id,
         ip=args.ip,
         port=args.port,
         feedback_url=args.event_server_url if args.feedback else None,
@@ -597,6 +604,27 @@ def cmd_dashboard(args) -> int:
     return 0
 
 
+def cmd_admin(args) -> int:
+    """Operator plumbing. ``pio admin reap`` flips stale-heartbeat INIT
+    engine instances (orphans of crashed/preempted trainers) to
+    ABANDONED; the same sweep also runs automatically at train start."""
+    from ..workflow.supervisor import heartbeat_age_s, reap_orphans
+
+    if args.admin_command == "reap":
+        meta = _storage().get_metadata()
+        reaped = reap_orphans(meta, stale_after_s=args.stale_after_s,
+                              dry_run=args.dry_run)
+        verb = "would reap" if args.dry_run else "reaped"
+        if not reaped:
+            _ok(f"No orphaned INIT engine instances older than "
+                f"{args.stale_after_s:.0f}s.")
+        for inst in reaped:
+            age = heartbeat_age_s(inst)
+            _ok(f"  {verb} {inst.id} (engine={inst.engine_id}, last "
+                f"liveness {age:.0f}s ago) -> ABANDONED")
+    return 0
+
+
 def cmd_status(args) -> int:
     """(reference `pio status`: storage verification, Console.scala:1061+)"""
     _ok(f"predictionio_tpu {__version__}")
@@ -605,6 +633,22 @@ def cmd_status(args) -> int:
     statuses = Storage.verify_all_data_objects()
     for repo, st in statuses.items():
         _ok(f"  {repo}: {st}")
+    try:
+        from ..workflow.supervisor import DEFAULT_STALE_AFTER_S, heartbeat_age_s
+
+        running = Storage.get_metadata().engine_instance_get_by_status("INIT")
+        for inst in running:
+            age = heartbeat_age_s(inst)
+            if age is None:
+                mark, shown = "orphan?", "never"
+            else:
+                mark = ("live" if age < DEFAULT_STALE_AFTER_S
+                        else "orphan? (reap with `pio admin reap`)")
+                shown = f"{age:.0f}s ago"
+            _ok(f"  training run {inst.id}: INIT, attempt={inst.attempt}, "
+                f"last heartbeat {shown} [{mark}]")
+    except Exception as e:  # noqa: BLE001 — status must keep printing
+        _ok(f"  training runs: unavailable ({e})")
     try:
         import jax
 
@@ -721,6 +765,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--profile-dir", default=None,
                     help="capture a jax.profiler trace of training into "
                          "this directory (view with TensorBoard/XProf)")
+    sp.add_argument("--max-retries", type=int, default=2,
+                    help="supervised retries for transient failures "
+                         "(preemption/device-lost/OOM); each retry resumes "
+                         "from the latest checkpoint (default 2)")
+    sp.add_argument("--retry-backoff-s", type=float, default=1.0,
+                    help="base of the jittered exponential retry backoff "
+                         "in seconds (default 1.0)")
+    sp.add_argument("--train-budget-s", type=float, default=0.0,
+                    help="wall-clock budget for the whole training run; "
+                         "past it the run aborts cleanly with status "
+                         "ABORTED instead of hanging (0 = unlimited)")
 
     sp = sub.add_parser("eval")
     _add_engine_args(sp)
@@ -819,6 +874,17 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("status")
 
+    sp = sub.add_parser("admin")
+    a_sub = sp.add_subparsers(dest="admin_command", required=True)
+    x = a_sub.add_parser("reap",
+                         help="flip stale-heartbeat INIT engine instances "
+                              "(orphans of dead trainers) to ABANDONED")
+    x.add_argument("--stale-after-s", type=float, default=600.0,
+                   help="an INIT instance whose last heartbeat (or start) "
+                        "is older than this is an orphan (default 600)")
+    x.add_argument("--dry-run", action="store_true",
+                   help="list the orphans without changing their status")
+
     sp = sub.add_parser("import")
     sp.add_argument("--appid", type=int, required=True)
     sp.add_argument("--channel", type=int, default=None)
@@ -854,6 +920,7 @@ COMMANDS = {
     "adminserver": cmd_adminserver,
     "dashboard": cmd_dashboard,
     "status": cmd_status,
+    "admin": cmd_admin,
     "import": cmd_import,
     "export": cmd_export,
     "template": cmd_template,

@@ -3,6 +3,7 @@
 from .checkpoint import TrainCheckpointer
 from .context import Context, WorkflowParams
 from .core_workflow import (
+    ModelIntegrityError,
     engine_params_from_instance,
     prepare_deploy,
     resolve_attr,
@@ -16,11 +17,21 @@ from .serialization import (
     deserialize_models,
     serialize_models,
 )
+from .supervisor import (
+    TrainBudgetExceeded,
+    TrainSupervisor,
+    TransientTrainingError,
+    classify_error,
+    reap_orphans,
+)
 
 __all__ = [
-    "Context", "PersistentModelManifest", "RetrainMarker", "TrainCheckpointer",
-    "WorkflowParams",
+    "Context", "ModelIntegrityError", "PersistentModelManifest",
+    "RetrainMarker", "TrainBudgetExceeded", "TrainCheckpointer",
+    "TrainSupervisor", "TransientTrainingError", "WorkflowParams",
+    "classify_error",
     "deserialize_models", "engine_params_from_instance", "prepare_deploy",
+    "reap_orphans",
     "resolve_attr", "resolve_engine_factory", "run_evaluation", "run_train",
     "serialize_models",
 ]

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import re
 import shutil
 from pathlib import Path
@@ -30,6 +31,41 @@ log = logging.getLogger("predictionio_tpu.workflow")
 __all__ = ["TrainCheckpointer"]
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _fsync_file(path: Path) -> None:
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _fsync_dir(path: Path) -> None:
+    """Durably record directory entries (new files / renames). Some
+    filesystems refuse O_RDONLY fsync on directories — a durability
+    best-effort there, same as most databases handle it."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _fsync_tree(root: Path) -> None:
+    """fsync every regular file under ``root``, then its directories
+    bottom-up, so the whole step's contents are on stable storage before
+    the ``_COMPLETE`` marker claims they are."""
+    for dirpath, _dirnames, filenames in os.walk(root, topdown=False):
+        d = Path(dirpath)
+        for fn in filenames:
+            _fsync_file(d / fn)
+        _fsync_dir(d)
 
 
 def _to_host(tree: Any) -> Any:
@@ -142,7 +178,11 @@ class TrainCheckpointer:
 
     # -- save / restore ----------------------------------------------------
     def save(self, step: int, state: Any) -> None:
-        """Write atomically: the step counts only once _COMPLETE lands.
+        """Write atomically AND durably: the step counts only once
+        _COMPLETE lands, and _COMPLETE lands only after the step's
+        contents are fsynced (file data, then the marker, then the parent
+        directory after the rename) — a power cut can surface a missing
+        checkpoint, never a "complete" one with torn contents.
 
         Overwrites are atomic too — the new state is written to a ``.tmp``
         sibling and swapped in, so a crash mid-overwrite never loses the
@@ -155,16 +195,22 @@ class TrainCheckpointer:
         if tmp.exists():  # leftover from a crashed save
             shutil.rmtree(tmp)
         self._backend.save(tmp, state)
-        (tmp / "_COMPLETE").write_text(json.dumps({"step": step}))
+        _fsync_tree(tmp)  # contents durable BEFORE the marker exists
+        marker = tmp / "_COMPLETE"
+        marker.write_text(json.dumps({"step": step}))
+        _fsync_file(marker)
+        _fsync_dir(tmp)
         if path.exists():
             old = self.directory / f"step_{step}.old"
             if old.exists():
                 shutil.rmtree(old)
             path.rename(old)
             tmp.rename(path)
+            _fsync_dir(self.directory)  # both renames durable together
             shutil.rmtree(old, ignore_errors=True)
         else:
             tmp.rename(path)
+            _fsync_dir(self.directory)
         log.info("checkpoint saved: step %d -> %s", step, path)
         # Retention prunes only steps <= the one just saved: steps beyond it
         # can exist legitimately (same run previously trained to a higher

@@ -24,6 +24,8 @@ from ..controller.evaluation import Evaluation, MetricEvaluator, MetricEvaluator
 from ..controller.params import EngineParams, params_to_json
 from ..storage import EngineInstance, EvaluationInstance, Model, Storage
 from .context import Context
+from .faults import FAULTS
+from .supervisor import DEFAULT_STALE_AFTER_S, TrainSupervisor, reap_orphans
 from .serialization import (
     PersistentModelManifest,
     RetrainMarker,
@@ -40,8 +42,12 @@ _SCOPED_ENGINE_DIRS: dict = {}
 
 __all__ = [
     "resolve_attr", "resolve_engine_factory", "run_train", "run_evaluation",
-    "prepare_deploy",
+    "prepare_deploy", "ModelIntegrityError",
 ]
+
+
+class ModelIntegrityError(RuntimeError):
+    """A stored model blob failed its checksum at deploy time."""
 
 
 def _import_engine_scoped(engine_dir, mod_name: str):
@@ -237,11 +243,28 @@ def run_train(
     engine_factory: str = "",
     batch: str = "",
     env: dict | None = None,
+    max_retries: int = 0,
+    retry_backoff_s: float = 1.0,
+    train_budget_s: float | None = None,
+    heartbeat_s: float = 5.0,
+    reap_stale_after_s: float = DEFAULT_STALE_AFTER_S,
 ) -> str:
     """Train and persist; returns the engine instance id
-    (CoreWorkflow.runTrain, CoreWorkflow.scala:42-94)."""
+    (CoreWorkflow.runTrain, CoreWorkflow.scala:42-94).
+
+    The body runs under a ``TrainSupervisor``: transient failures
+    (preemption / device-lost / injected chaos faults) are retried up to
+    ``max_retries`` times with jittered backoff, resuming from the
+    latest ``TrainCheckpointer`` step; a heartbeat stamps
+    ``last_heartbeat``/``attempt`` into the instance record; and
+    ``train_budget_s`` (None = unlimited) bounds the whole run's wall
+    clock, aborting cleanly (status ABORTED) instead of hanging. Stale
+    INIT orphans from previous dead runs are reaped first.
+    """
     ctx = ctx or Context(mode="Train", batch=batch)
     meta = Storage.get_metadata()
+    if reap_stale_after_s and reap_stale_after_s > 0:
+        reap_orphans(meta, stale_after_s=reap_stale_after_s)
     instance = EngineInstance(
         status="INIT",
         start_time=_now(),
@@ -257,9 +280,24 @@ def run_train(
         serving_params=_params_field(engine_params.serving_params),
     )
     instance_id = meta.engine_instance_insert(instance)
-    instance = dataclasses.replace(instance, id=instance_id)
     log.info("EngineInstance %s created; training starts", instance_id)
-    try:
+
+    def _stamp(status: str) -> EngineInstance:
+        """Final status flip over the FRESHEST record, so the
+        heartbeat's last_heartbeat/attempt stamps survive."""
+        cur = meta.engine_instance_get(instance_id) or dataclasses.replace(
+            instance, id=instance_id)
+        done = dataclasses.replace(cur, status=status, end_time=_now())
+        meta.engine_instance_update(done)
+        return done
+
+    def _on_heartbeat(iso: str, attempt: int) -> None:
+        cur = meta.engine_instance_get(instance_id)
+        if cur is not None and cur.status == "INIT":  # never clobber a final status
+            meta.engine_instance_update(dataclasses.replace(
+                cur, last_heartbeat=iso, attempt=attempt))
+
+    def _body() -> tuple[int, int]:
         from .tracing import maybe_profile, phase_report
 
         with maybe_profile(getattr(ctx, "profile_dir", None)):
@@ -267,16 +305,29 @@ def run_train(
         log.info("training phases: %s", phase_report(ctx))
         models = _persistable(result, instance_id)
         blob = serialize_models(models)
-        Storage.get_models().insert(Model(id=instance_id, models=blob))
-        meta.engine_instance_update(
-            dataclasses.replace(instance, status="COMPLETED", end_time=_now())
-        )
-        log.info("Training completed: instance %s (%d model(s), %d bytes)",
-                 instance_id, len(models), len(blob))
-    except Exception:
-        meta.engine_instance_update(
-            dataclasses.replace(instance, status="ABORTED", end_time=_now())
-        )
+        FAULTS.fire("train.persist")
+        Storage.get_models().insert(Model(
+            id=instance_id, models=blob,
+            checksum=Model.compute_checksum(blob)))
+        return len(models), len(blob)
+
+    supervisor = TrainSupervisor(
+        max_retries=max_retries,
+        retry_backoff_s=retry_backoff_s,
+        train_budget_s=train_budget_s,
+        heartbeat_s=heartbeat_s,
+        on_heartbeat=_on_heartbeat,
+    )
+    try:
+        n_models, n_bytes = supervisor.run(_body)
+        _stamp("COMPLETED")
+        log.info("Training completed: instance %s (%d model(s), %d bytes, "
+                 "%d attempt(s))",
+                 instance_id, n_models, n_bytes, supervisor.attempts)
+    except BaseException:
+        # BaseException, not Exception: Ctrl-C / SystemExit must not
+        # leave the instance stuck at INIT forever
+        _stamp("ABORTED")
         log.error("Training aborted:\n%s", traceback.format_exc())
         raise
     return instance_id
@@ -326,7 +377,8 @@ def run_evaluation(
         )
         log.info("Evaluation completed: instance %s", instance_id)
         return instance_id, result
-    except Exception:
+    except BaseException:
+        # as in run_train: Ctrl-C must not strand the record at INIT
         meta.evaluation_instance_update(
             dataclasses.replace(instance, status="ABORTED", end_time=_now())
         )
@@ -353,6 +405,12 @@ def prepare_deploy(
     blob = Storage.get_models().get(instance.id)
     if blob is None:
         raise RuntimeError(f"no model blob for engine instance {instance.id}")
+    if blob.checksum:  # pre-integrity blobs have no checksum to check
+        actual = Model.compute_checksum(blob.models)
+        if actual != blob.checksum:
+            raise ModelIntegrityError(
+                f"model blob for engine instance {instance.id} is corrupt: "
+                f"stored checksum {blob.checksum} != computed {actual}")
     stored = deserialize_models(blob.models, engine_dir=engine_dir)
 
     models: list[Any] = []

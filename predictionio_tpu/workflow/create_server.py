@@ -175,16 +175,28 @@ class EngineServer:
         engine_dir=None,
         retriever_mesh=None,
         retriever_axis: str = "model",
+        fallback: bool = True,
     ):
         self.engine = engine
         self.ctx = ctx or Context(mode="Serving")
         self.engine_dir = engine_dir  # for re-resolving blob classes
         self.batch_max = batch_max
-        self.deployed = Deployed(
-            instance,
-            prepare_deploy(engine, instance, self.ctx, engine_dir=engine_dir),
-            retriever_mesh=retriever_mesh, retriever_axis=retriever_axis,
-            prewarm_batch=batch_max)
+        #: instances skipped by the most recent deploy/reload because
+        #: their blob was corrupt or unloadable — surfaced in
+        #: /health.json and /stats.json so operators see the quarantine
+        self.deploy_skips: list[dict] = []
+        if fallback:
+            inst, result, self.deploy_skips = self._deploy_with_fallback(instance)
+            self.deployed = Deployed(
+                inst, result,
+                retriever_mesh=retriever_mesh, retriever_axis=retriever_axis,
+                prewarm_batch=batch_max)
+        else:  # explicitly pinned instance: fail loud, never substitute
+            self.deployed = Deployed(
+                instance,
+                prepare_deploy(engine, instance, self.ctx, engine_dir=engine_dir),
+                retriever_mesh=retriever_mesh, retriever_axis=retriever_axis,
+                prewarm_batch=batch_max)
         self.feedback_url = feedback_url
         self.access_key = access_key
         # lifecycle-owned feedback publisher: one shared session, tracked
@@ -379,6 +391,11 @@ class EngineServer:
                 "dispatchTimeoutS": self.dispatch_timeout_s,
             },
             "drain": {"active": self._draining, "complete": self._drained},
+            "model": {
+                "engineInstanceId": inst.id,
+                "fallbackActive": bool(self.deploy_skips),
+                "skipped": self.deploy_skips,
+            },
             "feedback": self.feedback.stats() if self.feedback else None,
         }
 
@@ -464,6 +481,47 @@ class EngineServer:
                 (dt / n - self.avg_serving_sec) * n / self.request_count)
         return outcomes
 
+    # -- deploy fallback (blob integrity / unloadable blobs) ---------------
+    def _deploy_with_fallback(self, first: EngineInstance):
+        """Try ``first``; when its blob is corrupt (ModelIntegrityError)
+        or unloadable, walk the next-newest COMPLETED instances of the
+        same engine triple. Returns (instance, TrainResult, skips);
+        re-raises the FIRST error when every candidate fails."""
+        candidates = [first]
+        try:
+            meta = Storage.get_metadata()
+            for c in meta.engine_instance_get_completed(
+                    first.engine_id, first.engine_version, first.engine_variant):
+                if all(c.id != x.id for x in candidates):
+                    candidates.append(c)
+        except Exception:  # metadata unreachable: just try `first`
+            log.exception("could not list fallback candidates")
+        skips: list[dict] = []
+        first_err: Exception | None = None
+        for cand in candidates:
+            try:
+                result = prepare_deploy(self.engine, cand, self.ctx,
+                                        engine_dir=self.engine_dir)
+            except Exception as e:  # noqa: BLE001 — try the next-newest
+                if first_err is None:
+                    first_err = e
+                skips.append({"engineInstanceId": cand.id,
+                              "error": f"{type(e).__name__}: {e}"})
+                log.error(
+                    "deploy of engine instance %s failed (%s: %s); "
+                    "falling back to the next-newest COMPLETED instance",
+                    cand.id, type(e).__name__, e)
+                continue
+            if skips:
+                log.warning(
+                    "deployed engine instance %s after skipping %d "
+                    "corrupt/unloadable newer instance(s): %s",
+                    cand.id, len(skips),
+                    [s["engineInstanceId"] for s in skips])
+            return cand, result, skips
+        assert first_err is not None
+        raise first_err
+
     # -- hot reload (MasterActor ReloadServer, :315-336) -------------------
     def reload_latest(self) -> str:
         with self._reload_lock:
@@ -477,14 +535,18 @@ class EngineServer:
         )
         if latest is None:
             raise RuntimeError("no COMPLETED engine instance to reload")
-        fresh = Deployed(latest, prepare_deploy(self.engine, latest, self.ctx,
-                                                engine_dir=self.engine_dir),
+        # fallback walk: a corrupt newest blob must not take down a
+        # healthy server — the old bundle keeps serving while we try the
+        # next-newest COMPLETED instance
+        fresh_inst, result, skips = self._deploy_with_fallback(latest)
+        fresh = Deployed(fresh_inst, result,
                          retriever_mesh=self.deployed.retriever_mesh,
                          retriever_axis=self.deployed.retriever_axis,
                          prewarm_batch=self.batch_max)
         self.deployed = fresh  # atomic reference swap
-        log.info("Reloaded engine instance %s", latest.id)
-        return latest.id
+        self.deploy_skips = skips
+        log.info("Reloaded engine instance %s", fresh_inst.id)
+        return fresh_inst.id
 
     def status(self) -> dict:
         inst = self.deployed.instance
@@ -525,6 +587,11 @@ class EngineServer:
                 "deadlineExpired": (self.batcher.deadline_expired
                                     if self.batcher else 0),
                 "draining": self._draining,
+            },
+            "model": {
+                "engineInstanceId": self.deployed.instance.id,
+                "fallbackActive": bool(self.deploy_skips),
+                "skipped": self.deploy_skips,
             },
             "feedback": self.feedback.stats() if self.feedback else None,
         }

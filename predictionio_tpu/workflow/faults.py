@@ -31,6 +31,14 @@ Sites instrumented in this repo:
 - ``eventserver.drain``     — before each drainer push of journaled
   records into the backend (async site; arm an un-bounded ``error`` for
   a hard storage outage the 201 acks must survive)
+- ``train.step``            — top of every ALS training iteration
+  (``models/als.train_als``; sync site; arm with ``after=N`` to kill a
+  run mid-training once checkpoints exist, proving the supervisor
+  resumes from the latest checkpoint instead of restarting)
+- ``train.persist``         — in ``run_train`` before the serialized
+  model blob is inserted (sync site; models a preemption between
+  training and persistence — the last moment a run can die with a full
+  model's work to lose)
 
 A fault is armed per site with a kind:
 
@@ -42,7 +50,10 @@ A fault is armed per site with a kind:
 
 ``times`` bounds how often the fault fires (then it disarms itself), so
 a test can hang exactly ``max_inflight`` dispatches and let recovery
-traffic through. ``fired(site)`` counts actual firings for assertions.
+traffic through; ``after`` skips the first N calls before the budget
+starts (skips don't count as firings), so a training fault can strike
+mid-run after checkpoints exist. ``fired(site)`` counts actual firings
+for assertions.
 """
 
 from __future__ import annotations
@@ -61,11 +72,12 @@ class FaultInjected(RuntimeError):
 class FaultSpec:
     """One armed fault: kind + budget + its release latch."""
 
-    __slots__ = ("kind", "exc", "delay_s", "max_hang_s", "times", "release_event")
+    __slots__ = ("kind", "exc", "delay_s", "max_hang_s", "times", "after",
+                 "release_event")
 
     def __init__(self, kind: str, *, exc: BaseException | None = None,
                  delay_s: float = 0.05, max_hang_s: float = 30.0,
-                 times: int | None = None):
+                 times: int | None = None, after: int = 0):
         if kind not in ("error", "slow", "hang"):
             raise ValueError(f"unknown fault kind {kind!r}")
         self.kind = kind
@@ -73,6 +85,7 @@ class FaultSpec:
         self.delay_s = delay_s
         self.max_hang_s = max_hang_s
         self.times = times  # None = every call until cleared
+        self.after = after  # skip the first N calls (not counted as fired)
         self.release_event = threading.Event() if kind == "hang" else None
 
 
@@ -102,16 +115,20 @@ class FaultInjector:
         return spec
 
     def clear(self, site: str | None = None) -> None:
-        """Disarm one site (or all), releasing any threads hung there."""
+        """Disarm one site (or all), releasing any threads hung there and
+        resetting the fired counters — a cleared site starts from a clean
+        slate, so per-test teardown isolates ``fired()`` assertions."""
         with self._lock:
             sites = ([site] if site is not None
-                     else list(self._armed.keys() | self._hanging.keys()))
+                     else list(self._armed.keys() | self._hanging.keys()
+                               | self._fired.keys()))
             for s in sites:
                 spec = self._armed.pop(s, None)
                 if spec is not None and spec.release_event is not None:
                     spec.release_event.set()
                 for ev in self._hanging.pop(s, []):
                     ev.set()
+                self._fired.pop(s, None)
 
     def release(self, site: str) -> None:
         """Unblock threads hung at ``site`` without disarming it."""
@@ -149,6 +166,9 @@ class FaultInjector:
         with self._lock:
             spec = self._armed.get(site)
             if spec is None:
+                return None
+            if spec.after > 0:
+                spec.after -= 1
                 return None
             if spec.times is not None:
                 if spec.times <= 0:

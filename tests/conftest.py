@@ -45,6 +45,13 @@ def pytest_configure(config):
         "ingest: durable event-ingestion tests (the write-ahead journal, "
         "drainer and backpressure surfaces — test_journal.py and "
         "test_ingest_durability.py); select with -m ingest")
+    config.addinivalue_line(
+        "markers",
+        "train_chaos: training-resilience fault-injection tests (the "
+        "TrainSupervisor retry/resume/heartbeat/budget surfaces, orphan "
+        "reaping and blob-integrity fallback — test_train_supervision.py); "
+        "shares the chaos guard's SIGALRM timeout and fault cleanup; "
+        "select with -m train_chaos")
 
 
 #: Hard per-test budget for chaos tests. Injected hangs are capped at
@@ -56,10 +63,12 @@ CHAOS_TEST_TIMEOUT_S = 120
 
 @pytest.fixture(autouse=True)
 def _chaos_guard(request):
-    """For @pytest.mark.chaos tests: arm a SIGALRM watchdog (pytest-timeout
-    is not in the image) and always disarm every injected fault on
-    teardown — a leaked armed fault would poison unrelated tests."""
-    if request.node.get_closest_marker("chaos") is None:
+    """For @pytest.mark.chaos / @pytest.mark.train_chaos tests: arm a
+    SIGALRM watchdog (pytest-timeout is not in the image) and always
+    disarm every injected fault on teardown — a leaked armed fault would
+    poison unrelated tests."""
+    if (request.node.get_closest_marker("chaos") is None
+            and request.node.get_closest_marker("train_chaos") is None):
         yield
         return
 

@@ -102,3 +102,25 @@ def test_pio_eventserver_help_documents_journal_flags(tmp_path):
         assert flag in out.stdout, f"{flag} missing from eventserver --help"
     for policy in ("always", "batch", "never"):
         assert policy in out.stdout
+
+
+def test_pio_train_help_documents_supervision_flags(tmp_path):
+    """The preemption-tolerance knobs are operator surface: `pio train
+    --help` must advertise the supervised-retry / budget flags the
+    Training-robustness runbook documents."""
+    env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
+    out = subprocess.run([str(REPO / "bin" / "pio"), "train", "--help"],
+                         capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    for flag in ("--max-retries", "--retry-backoff-s", "--train-budget-s"):
+        assert flag in out.stdout, f"{flag} missing from train --help"
+
+
+def test_pio_admin_reap_help_documents_flags(tmp_path):
+    env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
+    out = subprocess.run([str(REPO / "bin" / "pio"), "admin", "reap",
+                          "--help"],
+                         capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    for flag in ("--stale-after-s", "--dry-run"):
+        assert flag in out.stdout, f"{flag} missing from admin reap --help"

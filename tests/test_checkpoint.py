@@ -9,6 +9,8 @@ ALS run reproduces the uninterrupted run.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 import pytest
 
@@ -230,3 +232,63 @@ class TestOverwriteAtomicity:
         assert ck.steps() == [4]
         _, st = ck.restore()
         assert float(st["v"][0, 0]) == 7.0
+
+
+class TestDurability:
+    """save() must fsync contents BEFORE the _COMPLETE marker, the marker
+    itself, and the directories the renames happened in (ISSUE 4
+    satellite: a power cut can surface a missing checkpoint, never a
+    "complete" one with torn contents)."""
+
+    def test_save_fsyncs_files_marker_and_dirs(self, tmp_path, monkeypatch):
+        synced: list[str] = []
+        real_fsync = os.fsync
+
+        def recording_fsync(fd):
+            synced.append(os.readlink(f"/proc/self/fd/{fd}"))
+            real_fsync(fd)
+
+        monkeypatch.setattr(os, "fsync", recording_fsync)
+        ck = TrainCheckpointer(tmp_path / "ck", backend="npz")
+        ck.save(1, {"v": np.zeros((2, 2)), "it": np.int64(1)})
+
+        def idx(suffix):
+            hits = [i for i, p in enumerate(synced) if p.endswith(suffix)]
+            assert hits, f"nothing fsynced matching {suffix!r}: {synced}"
+            return hits[0]
+
+        # the npz payload, then the marker, then the root dir (post-rename)
+        assert idx("state.npz") < idx("_COMPLETE") < idx("/ck")
+        # the tmp step dir itself was synced before its rename
+        assert any("step_1.tmp" in p and p.endswith(".tmp") for p in synced)
+
+    def test_restore_first_valid_walks_past_corruption(self, tmp_path):
+        """ISSUE 4 satellite: the newest-first walk must skip a truncated
+        state.npz AND a foreign-shape step, landing on the newest step
+        that restores and validates."""
+        ck = TrainCheckpointer(tmp_path / "ck", backend="npz", keep=10)
+        good = {"u": np.zeros((4, 2), np.float32),
+                "v": np.zeros((3, 2), np.float32)}
+        ck.save(2, {**good, "it": np.int64(2)})
+        ck.save(4, {**good, "it": np.int64(4)})
+        # step 6: a foreign run's shapes — restores fine, fails validation
+        ck.save(6, {"u": np.zeros((9, 9), np.float32),
+                    "v": np.zeros((9, 9), np.float32), "it": np.int64(6)})
+        # step 8: torn on disk after the marker claimed completeness
+        ck.save(8, {**good, "it": np.int64(8)})
+        npz = ck.directory / "step_8" / "state.npz"
+        npz.write_bytes(npz.read_bytes()[:20])
+
+        def is_valid(state):
+            return state["u"].shape == (4, 2)
+
+        got = ck.restore_first_valid(is_valid)
+        assert got is not None
+        step, state = got
+        assert step == 4
+        assert int(state["it"]) == 4
+
+    def test_restore_first_valid_all_bad_returns_none(self, tmp_path):
+        ck = TrainCheckpointer(tmp_path / "ck", backend="npz")
+        ck.save(1, {"u": np.zeros((9, 9), np.float32), "it": np.int64(1)})
+        assert ck.restore_first_valid(lambda s: s["u"].shape == (4, 2)) is None

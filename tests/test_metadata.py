@@ -113,3 +113,81 @@ def test_sequences():
     assert s.next_id("x") == 1
     assert s.next_id("x") == 2
     assert s.next_id("y") == 1
+
+
+def test_engine_instance_heartbeat_attempt_roundtrip():
+    s = MetadataStore()
+    t = datetime(2026, 8, 5, 12, 0, 0, tzinfo=timezone.utc)
+    iid = s.engine_instance_insert(EngineInstance(
+        status="INIT", start_time=t,
+        last_heartbeat=t.isoformat(), attempt=2))
+    got = s.engine_instance_get(iid)
+    assert got.last_heartbeat == t.isoformat()
+    assert got.attempt == 2
+
+
+def test_engine_instance_get_by_status_ordering():
+    s = MetadataStore()
+    t0 = datetime(2026, 8, 5, 12, 0, 0, tzinfo=timezone.utc)
+    old = s.engine_instance_insert(EngineInstance(status="INIT", start_time=t0))
+    new = s.engine_instance_insert(EngineInstance(
+        status="INIT", start_time=t0 + timedelta(minutes=5)))
+    s.engine_instance_insert(EngineInstance(
+        status="COMPLETED", start_time=t0 + timedelta(minutes=9)))
+    assert [i.id for i in s.engine_instance_get_by_status("INIT")] == [new, old]
+    assert s.engine_instance_get_by_status("ABANDONED") == []
+
+
+def test_model_checksum_roundtrip():
+    s = MetadataStore()
+    blob = b"\x00\x01model bytes"
+    ck = Model.compute_checksum(blob)
+    assert ck.startswith("sha256:") and len(ck) == 7 + 64
+    s.model_insert(Model(id="i1", models=blob, checksum=ck))
+    m = s.model_get("i1")
+    assert m.checksum == ck
+    # legacy row without a checksum reads back as ""
+    s.model_insert(Model(id="i2", models=blob))
+    assert s.model_get("i2").checksum == ""
+
+
+def test_old_schema_database_migrates_in_place(tmp_path):
+    """A database created before last_heartbeat/attempt/checksum existed
+    must open cleanly: columns are added and old rows read back with the
+    dataclass defaults."""
+    import json
+    import sqlite3
+
+    path = str(tmp_path / "old.db")
+    conn = sqlite3.connect(path)
+    conn.executescript(
+        """
+        CREATE TABLE engine_instances (
+          id TEXT PRIMARY KEY, status TEXT, engine_id TEXT,
+          engine_version TEXT, engine_variant TEXT, start_time TEXT,
+          doc TEXT);
+        CREATE TABLE models (id TEXT PRIMARY KEY, blob BLOB);
+        """
+    )
+    doc = json.dumps({"id": "ei_old", "status": "COMPLETED",
+                      "start_time": "2026-08-01T00:00:00+00:00"})
+    conn.execute(
+        "INSERT INTO engine_instances VALUES (?,?,?,?,?,?,?)",
+        ("ei_old", "COMPLETED", "default", "1", "default",
+         "2026-08-01T00:00:00+00:00", doc))
+    conn.execute("INSERT INTO models VALUES (?,?)", ("ei_old", b"blob"))
+    conn.commit()
+    conn.close()
+
+    s = MetadataStore(path)
+    inst = s.engine_instance_get("ei_old")
+    assert inst.status == "COMPLETED"
+    assert inst.last_heartbeat == ""  # pre-migration rows get defaults
+    assert inst.attempt == 0
+    assert s.model_get("ei_old").checksum == ""
+    # and the migrated table accepts new-style writes
+    t = datetime(2026, 8, 5, tzinfo=timezone.utc)
+    iid = s.engine_instance_insert(EngineInstance(
+        status="INIT", start_time=t, last_heartbeat=t.isoformat(), attempt=1))
+    assert s.engine_instance_get(iid).attempt == 1
+    s.close()
