@@ -877,25 +877,28 @@ def sharded_retrieval_bench() -> dict:
 from predictionio_tpu.tools.serve_bench import sweep
 
 for r in sweep((1, 2, 4, 8)):
-    print("SHARDEDRET %d %.3f %.1f %s %.4f %d" % (
-        r["ways"], r["p50_ms"], r["qps"], r["merge"],
-        r["exec_cache_hit_rate"], r["batch"]))
+    print("SHARDEDRET %d %.3f %.3f %.3f %.1f %s %.4f %d" % (
+        r["ways"], r["p50_ms"], r["p95_ms"], r["p99_ms"], r["qps"],
+        r["merge"], r["exec_cache_hit_rate"], r["batch"]))
 """
     res = {}
     rows = _run_tagged_child(code, "SHARDEDRET", 900)
-    for ways, p50_ms, qps, merge, hit_rate, batch in rows:
+    for ways, p50_ms, p95_ms, p99_ms, qps, merge, hit_rate, batch in rows:
         res[f"sharded_topk_{ways}way_p50_ms"] = float(p50_ms)
+        res[f"sharded_topk_{ways}way_p95_ms"] = float(p95_ms)
+        res[f"sharded_topk_{ways}way_p99_ms"] = float(p99_ms)
         res[f"sharded_topk_{ways}way_qps"] = round(float(qps))
         res["sharded_topk_merge"] = merge
         res["sharded_topk_exec_cache_hit_rate"] = float(hit_rate)
         res["sharded_topk_batch"] = int(batch)
-    if len(res) != 11:  # 4 ways x 2 + 3 shared fields
+    if len(res) != 19:  # 4 ways x 4 + 3 shared fields
         raise RuntimeError(f"sharded retrieval bench incomplete: {res}")
     log(f"sharded retrieval sweep (64k x 64 catalog, batch-128 top-10, "
         f"virtual CPU mesh, merge={res['sharded_topk_merge']}, exec-cache "
         f"hit rate {res['sharded_topk_exec_cache_hit_rate']:.2f}): "
         + "; ".join(
-            f"{w}-way p50 {res[f'sharded_topk_{w}way_p50_ms']:.2f} ms "
+            f"{w}-way p50 {res[f'sharded_topk_{w}way_p50_ms']:.2f} / "
+            f"p99 {res[f'sharded_topk_{w}way_p99_ms']:.2f} ms "
             f"({res[f'sharded_topk_{w}way_qps']} qps)"
             for w in (1, 2, 4, 8)))
     return res

@@ -38,6 +38,9 @@ from datetime import datetime
 
 from aiohttp import web
 
+from ..obs.http import handle_metrics
+from ..obs.metrics import METRICS
+from ..obs.trace import TRACE_HEADER, ensure_request_id, trace_event
 from ..storage import (
     EventQuery,
     Storage,
@@ -64,6 +67,13 @@ INGEST_KEY = web.AppKey("ingest", object)
 #: drainer to free a segment, short enough that clients probe a
 #: recovering server promptly.
 BACKPRESSURE_RETRY_AFTER_S = 1
+
+# ISSUE 5: every booked ingest outcome, by HTTP status — the scrapeable
+# twin of the per-app Stats bookkeeping (which stays hourly/per-app)
+_M_EVENTS = METRICS.counter(
+    "pio_events_ingested_total",
+    "ingest outcomes by HTTP status (201/400/401/403/500/503)",
+    labelnames=("status",))
 
 
 @dataclass
@@ -140,6 +150,7 @@ def _bump_stats(request: web.Request, app_id: int, status: int,
     (EventAPI.scala:195-199 -> StatsActor.scala:28-70); that is what
     makes /stats.json useful for spotting rejected events. Requests
     failing auth before an app is known cannot be booked per-app."""
+    _M_EVENTS.inc(status=str(status))
     stats: Stats | None = request.app.get(STATS_KEY)
     if stats is None:
         return
@@ -170,6 +181,9 @@ async def _insert_one(
         e = ingest.assign_id(event)
         appended, err = await ingest.submit([e], auth.app_id, auth.channel_id)
         if appended == 1:
+            # event-path join, middle hop: ingress line -> this line ->
+            # the drainer's ingest.drain_batch line, all by trace id
+            trace_event("ingest.journal_append", event_id=e.event_id)
             _bump_stats(request, auth.app_id, 201, e)
             return 201, {"eventId": e.event_id}
         if err is None:
@@ -222,6 +236,10 @@ async def handle_root(request: web.Request) -> web.Response:
 
 
 async def handle_post_event(request: web.Request) -> web.Response:
+    # trace ingress (event path): the id set here rides inside the
+    # journal payload (api/ingest.py encode) so the drainer — even a
+    # post-crash replay in another process — joins back to this line
+    rid = ensure_request_id(request.headers.get(TRACE_HEADER))
     auth = await _authenticate(request, ingest=True)
     if isinstance(auth, web.Response):
         return auth
@@ -231,13 +249,18 @@ async def handle_post_event(request: web.Request) -> web.Response:
         _bump_stats(request, auth.app_id, 400)
         return _json_error(400, "Malformed JSON body.")
     status, body = await _insert_event_dict(request, auth, data)
-    return _ingest_response(status, body)
+    trace_event("ingest.ingress", status=status,
+                event_id=body.get("eventId") if isinstance(body, dict) else None)
+    resp = _ingest_response(status, body)
+    resp.headers[TRACE_HEADER] = rid
+    return resp
 
 
 async def handle_post_batch(request: web.Request) -> web.Response:
     """Batch ingestion: a JSON array of events; per-event status in order.
     (The reference gained /batch/events.json right after 0.9.2; the import
     tool also needs it.) Max 50 per request, like the official SDKs."""
+    rid = ensure_request_id(request.headers.get(TRACE_HEADER))
     auth = await _authenticate(request, ingest=True)
     if isinstance(auth, web.Response):
         return auth
@@ -333,7 +356,12 @@ async def handle_post_batch(request: web.Request) -> web.Response:
             for slot, event in valid:
                 status, body = await _insert_one(request, auth, event)
                 results[slot] = {"status": status, **body}
-    return _ingest_response(200, results)
+    trace_event("ingest.ingress", batch=len(data),
+                accepted=sum(1 for r in results
+                             if r and r.get("status") == 201))
+    resp = _ingest_response(200, results)
+    resp.headers[TRACE_HEADER] = rid
+    return resp
 
 
 async def handle_get_events(request: web.Request) -> web.Response:
@@ -507,6 +535,7 @@ def create_event_app(stats: bool = False,
     app.router.add_get("/events/{event_id}.json", handle_get_event)
     app.router.add_delete("/events/{event_id}.json", handle_delete_event)
     app.router.add_get("/stats.json", handle_stats)
+    app.router.add_get("/metrics", handle_metrics)
     app.router.add_get("/health.json", handle_health)
     app.router.add_post("/webhooks/{name}", handle_webhook_post)
     app.router.add_get("/webhooks/{name}", handle_webhook_get)

@@ -46,13 +46,27 @@ import random
 import time
 import uuid
 
+from ..obs.metrics import METRICS
+from ..obs.trace import current_request_id, trace_event
 from ..storage import Storage, event_from_api_dict, event_to_api_dict
 from ..storage.journal import EventJournal, JournalFull
+from ..obs.breaker import breaker_set as _breaker_set
 from ..workflow.faults import FAULTS
 
 log = logging.getLogger("predictionio_tpu.eventserver")
 
 __all__ = ["DurableIngestor", "JournalFull"]
+
+# ISSUE 5: the drain pipe's registry handles. Journal append/fsync
+# latency is recorded inside storage/journal.py; this side measures one
+# ordered backend push (peek -> insert -> advance) and the queue it
+# works off (lag).
+_M_DRAIN_BATCH = METRICS.histogram(
+    "pio_journal_drain_batch_seconds",
+    "one drainer batch: peek + backend push + cursor advance")
+_M_JOURNAL_LAG = METRICS.gauge(
+    "pio_journal_lag",
+    "journaled records not yet pushed to the event backend")
 
 
 class DurableIngestor:
@@ -100,11 +114,15 @@ class DurableIngestor:
     # -- ingest-side API ---------------------------------------------------
     def encode(self, event, app_id: int, channel_id: int | None) -> bytes:
         """One journal payload. The event id MUST already be assigned —
-        it is what makes replay idempotent."""
+        it is what makes replay idempotent. The ingress trace id rides
+        along (``"t"``) so the drainer's log line — possibly in a later
+        process after a crash/replay — still joins the ingress line."""
         assert event.event_id, "journal records require a pre-assigned id"
-        return json.dumps(
-            {"e": event_to_api_dict(event), "a": app_id, "c": channel_id},
-            separators=(",", ":")).encode()
+        d = {"e": event_to_api_dict(event), "a": app_id, "c": channel_id}
+        rid = current_request_id()
+        if rid:
+            d["t"] = rid
+        return json.dumps(d, separators=(",", ":")).encode()
 
     @staticmethod
     def assign_id(event):
@@ -119,8 +137,10 @@ class DurableIngestor:
         returned for a 500."""
         payloads = [self.encode(e, app_id, channel_id) for e in events]
         n, err = await asyncio.to_thread(self._append_batch, payloads)
-        if n and self._wake is not None:
-            self._wake.set()
+        if n:
+            _M_JOURNAL_LAG.set(self.journal.lag)
+            if self._wake is not None:
+                self._wake.set()
         return n, err
 
     def _append_batch(self, payloads: list[bytes]) -> tuple[int, Exception | None]:
@@ -151,6 +171,7 @@ class DurableIngestor:
         if self._state == "open":
             if now - self._opened_at >= self.breaker_reset_s:
                 self._state = "half_open"
+                _breaker_set("ingest", "half_open", prev="open")
                 return True
             return False
         return True  # half_open: the drainer IS the single probe
@@ -159,6 +180,7 @@ class DurableIngestor:
         if self._state != "closed":
             log.info("ingest drain breaker closed (backend recovered, "
                      "lag=%d)", self.journal.lag)
+            _breaker_set("ingest", "closed", prev=self._state)
         self._state = "closed"
         self._consecutive_failures = 0
         self._last_error = None
@@ -172,6 +194,7 @@ class DurableIngestor:
                 and self._consecutive_failures >= self.breaker_threshold):
             if self._state != "open":
                 self.breaker_opens += 1
+                _breaker_set("ingest", "open", prev=self._state)
                 log.warning(
                     "ingest drain breaker OPEN after %d consecutive "
                     "failures (last: %s); events keep acking into the "
@@ -183,6 +206,7 @@ class DurableIngestor:
     # -- drain loop --------------------------------------------------------
     async def _drain_once(self) -> bool:
         """Push one ordered batch; True on progress (or nothing to do)."""
+        t0 = time.perf_counter()
         records, pos = await asyncio.to_thread(
             self.journal.peek_batch, self.drain_batch)
         if not records:
@@ -191,7 +215,7 @@ class DurableIngestor:
             # chaos site: arm an error here for a deterministic backend
             # outage the acks must survive (workflow/faults.py)
             await FAULTS.afire("eventserver.drain")
-            await asyncio.to_thread(self._push_records, records)
+            traces = await asyncio.to_thread(self._push_records, records)
         except asyncio.CancelledError:
             raise
         except Exception as e:  # noqa: BLE001 — any backend failure retries
@@ -200,14 +224,24 @@ class DurableIngestor:
         await asyncio.to_thread(self.journal.advance, pos)
         self.drained_batches += 1
         self._on_push_success()
+        dt = time.perf_counter() - t0
+        _M_DRAIN_BATCH.record(dt)
+        _M_JOURNAL_LAG.set(self.journal.lag)
+        # the drainer's half of the event-path join: each journaled trace
+        # id reappears here, after the backend upsert committed
+        trace_event("ingest.drain_batch", trace=None,
+                    traces=[t for t in traces if t],
+                    records=len(records), ms=round(dt * 1e3, 3))
         return True
 
-    def _push_records(self, records: list[bytes]) -> None:
+    def _push_records(self, records: list[bytes]) -> list:
         """Decode + insert in journal order, grouping consecutive records
-        of one (app, channel) into one backend batch call."""
+        of one (app, channel) into one backend batch call. Returns the
+        journaled trace ids (for the drain-batch trace line)."""
         backend = Storage.get_events()
         group: list = []
         group_key: tuple[int, int | None] | None = None
+        traces: list = []
 
         def flush():
             if group:
@@ -216,12 +250,14 @@ class DurableIngestor:
 
         for raw in records:
             d = json.loads(raw.decode())
+            traces.append(d.get("t"))
             key = (d["a"], d["c"])
             if key != group_key:
                 flush()
                 group_key = key
             group.append(event_from_api_dict(d["e"]))
         flush()
+        return traces
 
     async def _drain_loop(self) -> None:
         assert self._wake is not None

@@ -41,9 +41,11 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import time
 
 import numpy as np
 
+from ..obs.metrics import METRICS
 from ..ops.neighbors import build_bilinear_layout
 from ..ops.retrieval import RetrievalServingMixin
 from ..storage.bimap import BiMap
@@ -51,6 +53,13 @@ from ..storage.frame import Ratings
 from ..workflow.faults import FAULTS
 
 log = logging.getLogger("predictionio_tpu.als")
+
+# ISSUE 5: per-iteration device time — the number ALX-style TPU ALS
+# tuning is done against (arXiv:2112.02194)
+_M_TRAIN_STEP = METRICS.histogram(
+    "pio_train_step_seconds",
+    "one ALS alternation (user+item half-steps); async dispatch means a "
+    "step observes the previous step's device time")
 
 __all__ = ["ALSModel", "ALSConfig", "train_als"]
 
@@ -983,7 +992,9 @@ def train_als(ratings: Ratings, config: ALSConfig, mesh=None, *,
         # chaos site: a preemption striking mid-training (arm with
         # after=N to let N iterations — and their checkpoints — land)
         FAULTS.fire("train.step")
+        t_step = time.perf_counter()
         u, v = step(u_bk, i_bk, carry_u, v)
+        _M_TRAIN_STEP.record(time.perf_counter() - t_step)
         carry_u = u
         done = it + 1
         if (checkpointer is not None and checkpoint_every > 0

@@ -1,0 +1,30 @@
+"""Unified telemetry core (ISSUE 5).
+
+The reference leaned on Spark's UI as its implicit profiler; this package
+replaces that substrate with a process-wide metrics registry
+(:mod:`.metrics`: counters, gauges, log-bucketed latency histograms with
+p50/p95/p99 snapshots and Prometheus text exposition) and request-scoped
+tracing (:mod:`.trace`: an ``X-PIO-Request-ID`` propagated from ingress
+through the journal/drainer on the event path and through the
+micro-batcher/feedback loop on the query path, emitted as structured
+JSON log lines joinable by trace id).
+
+Every subsystem instruments through the module-global ``METRICS``
+registry; the per-subsystem ``stats()`` dicts keep their JSON shapes and
+the servers additionally expose ``GET /metrics`` for scrapers.
+"""
+
+from .metrics import METRICS, MetricsRegistry  # noqa: F401
+from .trace import (  # noqa: F401
+    TRACE_HEADER,
+    current_request_id,
+    ensure_request_id,
+    new_request_id,
+    span,
+    trace_event,
+)
+
+__all__ = [
+    "METRICS", "MetricsRegistry", "TRACE_HEADER", "current_request_id",
+    "ensure_request_id", "new_request_id", "span", "trace_event",
+]

@@ -1,0 +1,36 @@
+"""Circuit-breaker gauges shared by every breaker in the codebase.
+
+Two breakers exist today (the feedback publisher and the ingest
+drainer); both report through the same two families so one dashboard
+panel covers them: ``pio_breaker_state{subsystem=...}`` (0 closed,
+1 half-open, 2 open — alert on ``max_over_time > 0``) and
+``pio_breaker_transitions_total{subsystem=...,to=...}`` (a stuck-open
+breaker shows a transition count that stopped moving while the state
+gauge stays at 2).
+"""
+
+from __future__ import annotations
+
+from .metrics import METRICS
+
+__all__ = ["breaker_set", "BREAKER_LEVEL"]
+
+BREAKER_LEVEL = {"closed": 0, "half_open": 1, "open": 2}
+
+_M_STATE = METRICS.gauge(
+    "pio_breaker_state",
+    "circuit-breaker state by subsystem (0=closed 1=half-open 2=open)",
+    labelnames=("subsystem",))
+_M_TRANSITIONS = METRICS.counter(
+    "pio_breaker_transitions_total",
+    "circuit-breaker state transitions by subsystem and target state",
+    labelnames=("subsystem", "to"))
+
+
+def breaker_set(subsystem: str, state: str,
+                prev: str | None = None) -> None:
+    """Stamp the state gauge; count the transition when ``prev`` (the
+    state before this change) differs."""
+    _M_STATE.set(BREAKER_LEVEL.get(state, 0), subsystem=subsystem)
+    if prev is not None and prev != state:
+        _M_TRANSITIONS.inc(subsystem=subsystem, to=state)

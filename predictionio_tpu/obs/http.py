@@ -1,0 +1,25 @@
+"""``GET /metrics`` — Prometheus text exposition for aiohttp apps.
+
+Mounted on the event server (:7070), the engine server (:8000) and the
+dashboard (:9000) so every plane is scrapeable with the same handler.
+aiohttp is imported lazily: the registry itself must stay importable in
+processes that never serve HTTP (train workers, the CLI).
+"""
+
+from __future__ import annotations
+
+from .metrics import METRICS
+
+__all__ = ["handle_metrics", "CONTENT_TYPE"]
+
+#: Prometheus text exposition v0.0.4 content type
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+async def handle_metrics(request):
+    from aiohttp import web
+
+    return web.Response(
+        text=METRICS.render_prometheus(),
+        headers={"Content-Type": CONTENT_TYPE},
+    )

@@ -1,0 +1,371 @@
+"""Process-wide metrics registry: counters, gauges, latency histograms.
+
+Design constraints (ISSUE 5 tentpole):
+
+- **No dependencies.** Pure stdlib — the prometheus_client package is not
+  in the image, so the text exposition is rendered here.
+- **O(1) record.** Histograms use fixed log-spaced bucket boundaries
+  computed once; ``record()`` is a ``math.log`` + two adds under a lock,
+  never a sort or a sample reservoir.
+- **Thread + asyncio safe.** Every mutation holds a plain
+  ``threading.Lock``; asyncio callers never await inside the registry so
+  a sync lock cannot deadlock the loop, and worker threads (journal
+  fsync, micro-batch dispatch, train supervisor heartbeat) share the
+  same counters safely.
+- **Snapshot quantiles.** ``Histogram.snapshot()`` yields count/sum/
+  p50/p95/p99 estimated by linear interpolation inside the bucket that
+  crosses the target rank — the same estimate Prometheus's
+  ``histogram_quantile`` would compute from the exported buckets.
+
+The module-global ``METRICS`` registry is the process's single telemetry
+sink; subsystems hold metric handles created at import time and
+``METRICS.reset()`` zeroes values in place (handles stay valid) so tests
+can isolate without re-importing the world.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Iterable
+
+__all__ = [
+    "METRICS",
+    "MetricsRegistry",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "DEFAULT_TIME_BUCKETS_S",
+]
+
+#: Log-spaced latency boundaries in seconds: 0.1 ms doubling up to
+#: ~3.5 min, 22 finite buckets + overflow. Covers a 64 us device call and
+#: a 120 s hung drain with the same fixed table.
+_BUCKET_MIN_S = 1e-4
+_BUCKET_FACTOR = 2.0
+_BUCKET_COUNT = 22
+DEFAULT_TIME_BUCKETS_S: tuple[float, ...] = tuple(
+    _BUCKET_MIN_S * _BUCKET_FACTOR ** i for i in range(_BUCKET_COUNT)
+)
+
+
+def _escape_label(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_labels(names: tuple[str, ...], values: tuple[str, ...],
+                extra: tuple[tuple[str, str], ...] = ()) -> str:
+    pairs = [f'{n}="{_escape_label(v)}"' for n, v in zip(names, values)]
+    pairs += [f'{n}="{_escape_label(v)}"' for n, v in extra]
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+def _fmt_value(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if isinstance(v, float) and v.is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+class _Metric:
+    """Base: one metric family, optionally with label dimensions. Child
+    time series are keyed by their label-value tuple; the unlabeled
+    family uses the empty tuple."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str,
+                 labelnames: tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.labelnames = labelnames
+        self._lock = threading.Lock()
+        self._series: dict[tuple[str, ...], float] = {}
+
+    def labels(self, **kv: str) -> "_Child":
+        if tuple(sorted(kv)) != tuple(sorted(self.labelnames)):
+            raise ValueError(
+                f"{self.name}: expected labels {self.labelnames}, "
+                f"got {tuple(kv)}")
+        key = tuple(str(kv[n]) for n in self.labelnames)
+        return _Child(self, key)
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._series = {k: 0.0 for k in self._series}
+
+    # -- accessors ---------------------------------------------------
+    def value(self, *label_values: str) -> float:
+        key = tuple(str(v) for v in label_values)
+        with self._lock:
+            return self._series.get(key, 0.0)
+
+    def series(self) -> dict[tuple[str, ...], float]:
+        with self._lock:
+            return dict(self._series)
+
+    def render(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            items = sorted(self._series.items())
+        if not items and not self.labelnames:
+            items = [((), 0.0)]
+        for key, v in items:
+            lines.append(
+                f"{self.name}{_fmt_labels(self.labelnames, key)} "
+                f"{_fmt_value(v)}")
+        return lines
+
+
+class _Child:
+    """One labeled time series of a Counter/Gauge family."""
+
+    __slots__ = ("_metric", "_key")
+
+    def __init__(self, metric: _Metric, key: tuple[str, ...]):
+        self._metric = metric
+        self._key = key
+
+    def inc(self, n: float = 1.0) -> None:
+        m = self._metric
+        with m._lock:
+            m._series[self._key] = m._series.get(self._key, 0.0) + n
+
+    def set(self, v: float) -> None:
+        m = self._metric
+        with m._lock:
+            m._series[self._key] = float(v)
+
+    @property
+    def value(self) -> float:
+        m = self._metric
+        with m._lock:
+            return m._series.get(self._key, 0.0)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, n: float = 1.0, **kv: str) -> None:
+        key = (tuple(str(kv[n_]) for n_ in self.labelnames) if kv else ())
+        if kv and len(kv) != len(self.labelnames):
+            raise ValueError(f"{self.name}: labels {self.labelnames} required")
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + n
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, v: float, **kv: str) -> None:
+        key = (tuple(str(kv[n_]) for n_ in self.labelnames) if kv else ())
+        with self._lock:
+            self._series[key] = float(v)
+
+
+class Histogram:
+    """Log-bucketed latency histogram (unlabeled; one family = one site).
+
+    ``record(v)`` is O(1): the bucket index is
+    ``ceil(log(v/min)/log(factor))`` clamped into the fixed table, so a
+    0 or negative observation lands in bucket 0 and anything above the
+    top boundary lands in the overflow (``+Inf``) bucket. Quantiles
+    interpolate linearly within the crossing bucket; an overflow-bucket
+    quantile reports the top finite boundary (the histogram cannot see
+    further).
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help_: str,
+                 buckets: Iterable[float] = DEFAULT_TIME_BUCKETS_S):
+        self.name = name
+        self.help = help_
+        self.bounds: tuple[float, ...] = tuple(sorted(buckets))
+        if not self.bounds or any(b <= 0 for b in self.bounds):
+            raise ValueError("histogram bucket bounds must be positive")
+        self._log_min = math.log(self.bounds[0])
+        self._log_factor = (
+            math.log(self.bounds[1] / self.bounds[0])
+            if len(self.bounds) > 1 else 1.0)
+        self._lock = threading.Lock()
+        # counts[i] observations <= bounds[i]; counts[-1] is overflow
+        self._counts = [0] * (len(self.bounds) + 1)
+        self._count = 0
+        self._sum = 0.0
+
+    def _index(self, v: float) -> int:
+        if v <= self.bounds[0]:
+            return 0
+        if v > self.bounds[-1]:
+            return len(self.bounds)  # overflow
+        # O(1) for the log-spaced default table; falls back to a scan
+        # only when the computed slot disagrees (custom bucket tables)
+        i = int(math.ceil((math.log(v) - self._log_min)
+                          / self._log_factor - 1e-9))
+        i = min(max(i, 0), len(self.bounds) - 1)
+        if self.bounds[i] >= v and (i == 0 or self.bounds[i - 1] < v):
+            return i
+        for j, b in enumerate(self.bounds):
+            if v <= b:
+                return j
+        return len(self.bounds)
+
+    def record(self, v: float) -> None:
+        i = self._index(v)
+        with self._lock:
+            self._counts[i] += 1
+            self._count += 1
+            self._sum += v
+
+    def _quantile_locked(self, q: float) -> float:
+        if self._count == 0:
+            return 0.0
+        rank = q * self._count
+        cum = 0
+        for i, c in enumerate(self._counts):
+            if c == 0:
+                continue
+            lo = 0.0 if i == 0 else self.bounds[i - 1]
+            if i >= len(self.bounds):
+                return self.bounds[-1]  # overflow: report top boundary
+            hi = self.bounds[i]
+            if cum + c >= rank:
+                frac = (rank - cum) / c
+                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+            cum += c
+        return self.bounds[-1]
+
+    def quantile(self, q: float) -> float:
+        with self._lock:
+            return self._quantile_locked(q)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "count": self._count,
+                "sum": self._sum,
+                "p50": self._quantile_locked(0.50),
+                "p95": self._quantile_locked(0.95),
+                "p99": self._quantile_locked(0.99),
+            }
+
+    def _reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * (len(self.bounds) + 1)
+            self._count = 0
+            self._sum = 0.0
+
+    def render(self) -> list[str]:
+        with self._lock:
+            counts = list(self._counts)
+            total, s = self._count, self._sum
+            q50 = self._quantile_locked(0.50)
+            q95 = self._quantile_locked(0.95)
+            q99 = self._quantile_locked(0.99)
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        cum = 0
+        for b, c in zip(self.bounds, counts):
+            cum += c
+            lines.append(
+                f'{self.name}_bucket{{le="{_fmt_value(b)}"}} {cum}')
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {total}')
+        lines.append(f"{self.name}_sum {_fmt_value(float(s))}")
+        lines.append(f"{self.name}_count {total}")
+        # precomputed quantiles as a sibling summary family, so scrapers
+        # without histogram_quantile (and humans with curl) read p99
+        # straight off the page
+        qn = f"{self.name}_summary"
+        lines.append(f"# HELP {qn} precomputed quantiles of {self.name}")
+        lines.append(f"# TYPE {qn} summary")
+        lines.append(f'{qn}{{quantile="0.5"}} {_fmt_value(float(q50))}')
+        lines.append(f'{qn}{{quantile="0.95"}} {_fmt_value(float(q95))}')
+        lines.append(f'{qn}{{quantile="0.99"}} {_fmt_value(float(q99))}')
+        lines.append(f"{qn}_sum {_fmt_value(float(s))}")
+        lines.append(f"{qn}_count {total}")
+        return lines
+
+
+class MetricsRegistry:
+    """All metric families of one process, keyed by family name.
+
+    Re-registering an existing name with the same kind returns the
+    existing family (modules may be re-imported in tests); a kind clash
+    is a programming error and raises.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}
+
+    def _register(self, cls, name: str, help_: str, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise ValueError(
+                        f"metric {name} already registered as "
+                        f"{type(m).__name__}")
+                return m
+            m = cls(name, help_, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help_: str = "",
+                labelnames: tuple[str, ...] = ()) -> Counter:
+        return self._register(Counter, name, help_, labelnames=labelnames)
+
+    def gauge(self, name: str, help_: str = "",
+              labelnames: tuple[str, ...] = ()) -> Gauge:
+        return self._register(Gauge, name, help_, labelnames=labelnames)
+
+    def histogram(self, name: str, help_: str = "",
+                  buckets: Iterable[float] = DEFAULT_TIME_BUCKETS_S,
+                  ) -> Histogram:
+        return self._register(Histogram, name, help_, buckets=buckets)
+
+    def get(self, name: str):
+        with self._lock:
+            return self._metrics.get(name)
+
+    def reset(self) -> None:
+        """Zero every value in place; handles held by subsystems stay
+        valid. Used by the test suite between tests."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            m._reset()
+
+    def snapshot(self) -> dict:
+        """JSON-friendly view: {counters: {...}, gauges: {...},
+        histograms: {name: {count,sum,p50,p95,p99}}}. Labeled series
+        key as ``name{label="v"}``."""
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+        for name, m in metrics:
+            if isinstance(m, Histogram):
+                out["histograms"][name] = m.snapshot()
+                continue
+            dest = out["counters"] if isinstance(m, Counter) else out["gauges"]
+            for key, v in sorted(m.series().items()):
+                label = _fmt_labels(m.labelnames, key)
+                dest[f"{name}{label}"] = v
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition v0.0.4 of every family, ending in
+        the required trailing newline."""
+        with self._lock:
+            metrics = sorted(self._metrics.items())
+        lines: list[str] = []
+        for _, m in metrics:
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+#: the process-wide registry every subsystem instruments through
+METRICS = MetricsRegistry()

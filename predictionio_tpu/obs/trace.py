@@ -1,0 +1,92 @@
+"""Request-scoped tracing: one id from ingress to the last side effect.
+
+A request id is accepted from the ``X-PIO-Request-ID`` header or minted
+at ingress, stored in a :mod:`contextvars` ContextVar (so it follows the
+request across ``await`` points and into ``asyncio.to_thread`` workers,
+which copy the context), and emitted in structured JSON log lines that
+are joinable by ``trace``:
+
+- query path: ingress → micro-batch queue wait → batched dispatch →
+  device execute → feedback publish (the feedback event also carries a
+  ``pio_request_id`` property so event-store rows join back);
+- event path: ingress → journal append → drainer batch → backend
+  upsert (the id rides inside the journal payload so a crash/replay
+  keeps the join).
+
+Lines go to the ``pio.trace`` logger as single-line JSON:
+``{"evt": "serve.ingress", "trace": "ab12...", "ms": 1.93, ...}``.
+``grep <trace-id>`` over the log is the whole query language.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import logging
+import time
+import uuid
+from contextlib import contextmanager
+
+__all__ = [
+    "TRACE_HEADER",
+    "current_request_id",
+    "ensure_request_id",
+    "new_request_id",
+    "set_request_id",
+    "span",
+    "trace_event",
+]
+
+#: the propagation header, accepted at ingress and echoed on responses
+TRACE_HEADER = "X-PIO-Request-ID"
+
+log = logging.getLogger("pio.trace")
+
+_request_id: contextvars.ContextVar[str | None] = contextvars.ContextVar(
+    "pio_request_id", default=None)
+
+
+def new_request_id() -> str:
+    return uuid.uuid4().hex
+
+
+def current_request_id() -> str | None:
+    return _request_id.get()
+
+
+def set_request_id(rid: str | None) -> contextvars.Token:
+    return _request_id.set(rid)
+
+
+def ensure_request_id(rid: str | None = None) -> str:
+    """Adopt ``rid`` (e.g. from the ingress header), else keep the
+    context's current id, else mint one. Returns the id now in effect."""
+    got = rid or _request_id.get()
+    if not got:
+        got = new_request_id()
+    _request_id.set(got)
+    return got
+
+
+def trace_event(evt: str, *, trace: str | None = None, **fields) -> None:
+    """Emit one structured line. ``trace`` overrides the context id (a
+    batched dispatch logs once with every member id instead)."""
+    rec = {"evt": evt, "trace": trace or _request_id.get()}
+    rec.update(fields)
+    log.info("%s", json.dumps(rec, sort_keys=True, default=str))
+
+
+@contextmanager
+def span(evt: str, *, trace: str | None = None, **fields):
+    """Time a block and emit one line with its duration in ms. Yields a
+    dict the block may add fields to (e.g. row counts learned mid-span)."""
+    extra: dict = {}
+    t0 = time.perf_counter()
+    try:
+        yield extra
+    except BaseException as e:
+        extra["error"] = f"{type(e).__name__}: {e}"
+        raise
+    finally:
+        ms = (time.perf_counter() - t0) * 1e3
+        trace_event(evt, trace=trace, ms=round(ms, 3), **{**fields, **extra})

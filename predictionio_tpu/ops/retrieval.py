@@ -28,11 +28,19 @@ import threading
 
 import numpy as np
 
+from ..obs.metrics import METRICS
 from ..workflow.faults import FAULTS
 
 __all__ = ["topk_scores", "DeviceRetriever", "ShardedDeviceRetriever",
            "RetrievalServingMixin", "row_normalize", "ExecutableCache",
            "EXEC_CACHE"]
+
+# ISSUE 5: the executable cache's behavior under shape churn, scrapeable
+# (stats() keeps its dict shape for /stats.json; same increments)
+_M_EXEC_CACHE = METRICS.counter(
+    "pio_exec_cache_total",
+    "compiled-executable cache events (hit/miss/evict)",
+    labelnames=("event",))
 
 
 def row_normalize(x: np.ndarray) -> np.ndarray:
@@ -87,8 +95,10 @@ class ExecutableCache:
                 self.hits += 1
                 val = self._entries.pop(key)
                 self._entries[key] = val  # re-insert at the recent end
+                _M_EXEC_CACHE.inc(event="hit")
                 return val
             self.misses += 1
+        _M_EXEC_CACHE.inc(event="miss")
         val = build()
         with self._lock:
             if key in self._entries:
@@ -100,6 +110,7 @@ class ExecutableCache:
                     break  # everything pinned: admit over budget
                 self._entries.pop(victim)
                 self.evictions += 1
+                _M_EXEC_CACHE.inc(event="evict")
             self._entries[key] = val
         return val
 

@@ -51,10 +51,21 @@ import logging
 import os
 import struct
 import threading
+import time
 import zlib
 from pathlib import Path
 
+from ..obs.metrics import METRICS
 from ..workflow.faults import FAULTS
+
+# ISSUE 5: journal durability costs, scrapeable (the stats() dict keeps
+# its raw-counter shape; these add the latency distributions)
+_M_APPEND = METRICS.histogram(
+    "pio_journal_append_seconds",
+    "EventJournal.append wall time (frame + write + policy fsync)")
+_M_FSYNC = METRICS.histogram(
+    "pio_journal_fsync_seconds",
+    "journal fsync wall time (the durability floor of a 201 ack)")
 
 log = logging.getLogger("predictionio_tpu.journal")
 
@@ -287,6 +298,13 @@ class EventJournal:
         record is NOT written). With policy ``always`` the record is
         fsynced before return; with ``batch`` the caller must ``sync()``
         before acking."""
+        t0 = time.perf_counter()
+        try:
+            return self._append_timed(payload)
+        finally:
+            _M_APPEND.record(time.perf_counter() - t0)
+
+    def _append_timed(self, payload: bytes) -> int:
         FAULTS.fire("journal.append")
         frame = _HEADER.pack(len(payload), zlib.crc32(payload)) + payload
         with self._lock:
@@ -326,11 +344,13 @@ class EventJournal:
     def _sync_locked(self) -> None:
         if self.unsynced_bytes == 0 or self._write_fh is None:
             return
+        t0 = time.perf_counter()
         FAULTS.fire("journal.fsync")
         self._write_fh.flush()
         os.fsync(self._write_fh.fileno())
         self.synced += 1
         self.unsynced_bytes = 0
+        _M_FSYNC.record(time.perf_counter() - t0)
 
     # -- drain path --------------------------------------------------------
     def peek_batch(self, max_records: int) -> tuple[list[bytes], tuple[int, int, int]]:

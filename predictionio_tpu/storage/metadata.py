@@ -102,6 +102,10 @@ class EngineInstance:
     last_heartbeat: str = ""
     #: supervised retry attempt currently running (0 = first attempt)
     attempt: int = 0
+    #: JSON list of [phase, seconds] pairs from the SUCCESSFUL training
+    #: attempt (tracing.phase_times_json) — `pio status` shows where the
+    #: run's wall clock went. Empty for pre-telemetry records.
+    phase_times: str = ""
 
 
 @dataclass(frozen=True)

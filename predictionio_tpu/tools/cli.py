@@ -22,6 +22,7 @@ console/Console.scala:128-1245). Same verb set, no JVM/spark-submit spawning
   pio template list|get
   pio status | version
   pio admin reap [--stale-after-s N] [--dry-run]
+  pio admin metrics [--json]
 
 Engine directory convention (replacing the reference's sbt build + jar
 manifest): an engine dir holds ``engine.json`` whose ``engineFactory``
@@ -607,9 +608,32 @@ def cmd_dashboard(args) -> int:
 def cmd_admin(args) -> int:
     """Operator plumbing. ``pio admin reap`` flips stale-heartbeat INIT
     engine instances (orphans of crashed/preempted trainers) to
-    ABANDONED; the same sweep also runs automatically at train start."""
+    ABANDONED; the same sweep also runs automatically at train start.
+    ``pio admin metrics`` dumps this process's telemetry registry —
+    counters, gauges, and histogram quantiles (the in-process view of
+    what a server exports at ``GET /metrics``)."""
     from ..workflow.supervisor import heartbeat_age_s, reap_orphans
 
+    if args.admin_command == "metrics":
+        from ..obs.metrics import METRICS
+
+        snap = METRICS.snapshot()
+        if args.json:
+            _ok(json.dumps(snap, indent=2, sort_keys=True))
+            return 0
+        for section in ("counters", "gauges"):
+            vals = snap[section]
+            if vals:
+                _ok(f"{section}:")
+            for name, v in sorted(vals.items()):
+                _ok(f"  {name:56s} {v:g}")
+        if snap["histograms"]:
+            _ok("histograms (seconds):")
+        for name, h in sorted(snap["histograms"].items()):
+            _ok(f"  {name:44s} n={h['count']:<8d} "
+                f"p50={h['p50'] * 1e3:9.3f}ms p95={h['p95'] * 1e3:9.3f}ms "
+                f"p99={h['p99'] * 1e3:9.3f}ms")
+        return 0
     if args.admin_command == "reap":
         meta = _storage().get_metadata()
         reaped = reap_orphans(meta, stale_after_s=args.stale_after_s,
@@ -649,6 +673,19 @@ def cmd_status(args) -> int:
                 f"last heartbeat {shown} [{mark}]")
     except Exception as e:  # noqa: BLE001 — status must keep printing
         _ok(f"  training runs: unavailable ({e})")
+    try:
+        done = Storage.get_metadata().engine_instance_get_by_status("COMPLETED")
+        for inst in done[:3]:  # newest first; keep status terse
+            phases = json.loads(inst.phase_times) if inst.phase_times else []
+            if not phases:
+                continue
+            total = sum(dt for _, dt in phases)
+            breakdown = ", ".join(
+                f"{p}={dt:.2f}s"
+                for p, dt in sorted(phases, key=lambda x: -x[1]))
+            _ok(f"  completed run {inst.id}: {total:.2f}s ({breakdown})")
+    except Exception as e:  # noqa: BLE001
+        _ok(f"  completed runs: unavailable ({e})")
     try:
         import jax
 
@@ -884,6 +921,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "is older than this is an orphan (default 600)")
     x.add_argument("--dry-run", action="store_true",
                    help="list the orphans without changing their status")
+    x = a_sub.add_parser("metrics",
+                         help="dump this process's telemetry registry "
+                              "(counters, gauges, histogram quantiles)")
+    x.add_argument("--json", action="store_true",
+                   help="machine-readable snapshot instead of the table")
 
     sp = sub.add_parser("import")
     sp.add_argument("--appid", type=int, required=True)

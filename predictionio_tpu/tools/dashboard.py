@@ -14,6 +14,7 @@ import logging
 
 from aiohttp import web
 
+from ..obs.http import handle_metrics
 from ..storage import Storage
 
 log = logging.getLogger("predictionio_tpu.dashboard")
@@ -105,6 +106,7 @@ def create_dashboard_app() -> web.Application:
     app.router.add_get(
         "/engine_instances/{instance_id}/evaluator_results.json", handle_results_json
     )
+    app.router.add_get("/metrics", handle_metrics)
     return app
 
 

@@ -21,6 +21,8 @@ import time
 
 import numpy as np
 
+from ..obs.metrics import Histogram
+
 __all__ = ["sweep", "format_table", "main", "DEFAULT_WAYS", "DEFAULT_BATCH"]
 
 DEFAULT_WAYS = (1, 2, 4, 8)
@@ -28,6 +30,12 @@ DEFAULT_WAYS = (1, 2, 4, 8)
 # [B, n_items] block does not — the regime the r5 inversion hid
 # (docs/PERF_NOTES.md "Closing the sharded-serving inversion")
 DEFAULT_BATCH = 128
+
+# The serving histograms' default table doubles per bucket — right for
+# always-on telemetry, too coarse for committed benchmark numbers. The
+# bench rows use the same Histogram machinery over a ~19%-step geometric
+# table (10 us .. ~2.4 s), so interpolation error stays under one step.
+_BENCH_BUCKETS_S = tuple(1e-5 * (2 ** 0.25) ** i for i in range(72))
 
 
 def sweep(ways=DEFAULT_WAYS, *, n_items: int = 65_536, rank: int = 64,
@@ -58,18 +66,21 @@ def sweep(ways=DEFAULT_WAYS, *, n_items: int = 65_536, rank: int = 64,
         ret = ShardedDeviceRetriever(items, mesh)
         ret.prewarm(batch_sizes=(batch,), ks=(k,))
         ret.topk(q, k)  # warm the non-compile parts of the path too
-        lat = []
+        hist = Histogram("pio_bench_serve_seconds",
+                         "one batched topk round trip (device call + the "
+                         "single packed host pull)", buckets=_BENCH_BUCKETS_S)
         for _ in range(iters):
             t0 = time.perf_counter()
             vals, _ = ret.topk(q, k)
             np.asarray(vals)  # host fence: time includes the one pull
-            lat.append(time.perf_counter() - t0)
-        lat.sort()
-        p50 = lat[len(lat) // 2]
+            hist.record(time.perf_counter() - t0)
+        snap = hist.snapshot()
         rows.append({
             "ways": w,
-            "p50_ms": p50 * 1e3,
-            "qps": batch / p50,
+            "p50_ms": snap["p50"] * 1e3,
+            "p95_ms": snap["p95"] * 1e3,
+            "p99_ms": snap["p99"] * 1e3,
+            "qps": batch / max(snap["p50"], 1e-9),
             "merge": ret.merge,
             "exec_cache_hit_rate": EXEC_CACHE.stats()["hitRate"],
             "batch": batch,
@@ -80,12 +91,13 @@ def sweep(ways=DEFAULT_WAYS, *, n_items: int = 65_536, rank: int = 64,
 
 
 def format_table(rows: list[dict]) -> str:
-    head = f"{'ways':>4}  {'p50_ms':>8}  {'qps':>8}  {'merge':>6}  " \
-           f"{'cache_hit':>9}"
+    head = f"{'ways':>4}  {'p50_ms':>8}  {'p95_ms':>8}  {'p99_ms':>8}  " \
+           f"{'qps':>8}  {'merge':>6}  {'cache_hit':>9}"
     lines = [head, "-" * len(head)]
     for r in rows:
         lines.append(
-            f"{r['ways']:>4}  {r['p50_ms']:>8.3f}  {r['qps']:>8.0f}  "
+            f"{r['ways']:>4}  {r['p50_ms']:>8.3f}  {r['p95_ms']:>8.3f}  "
+            f"{r['p99_ms']:>8.3f}  {r['qps']:>8.0f}  "
             f"{r['merge']:>6}  {r['exec_cache_hit_rate']:>9.3f}")
     return "\n".join(lines)
 

@@ -21,12 +21,19 @@ import logging
 import os
 import re
 import shutil
+import time
 from pathlib import Path
 from typing import Any
 
 import numpy as np
 
+from ..obs.metrics import METRICS
+
 log = logging.getLogger("predictionio_tpu.workflow")
+
+_M_CKPT_SAVE = METRICS.histogram(
+    "pio_checkpoint_save_seconds",
+    "full durable checkpoint save (backend write + fsync tree + swap)")
 
 __all__ = ["TrainCheckpointer"]
 
@@ -188,6 +195,13 @@ class TrainCheckpointer:
         sibling and swapped in, so a crash mid-overwrite never loses the
         previously complete checkpoint of the same step.
         """
+        t0 = time.perf_counter()
+        try:
+            self._save(step, state)
+        finally:
+            _M_CKPT_SAVE.record(time.perf_counter() - t0)
+
+    def _save(self, step: int, state: Any) -> None:
         self.directory.mkdir(parents=True, exist_ok=True)
         self._recover()  # settle any interrupted swap before starting ours
         path = self._step_dir(step)

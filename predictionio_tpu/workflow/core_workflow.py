@@ -282,12 +282,13 @@ def run_train(
     instance_id = meta.engine_instance_insert(instance)
     log.info("EngineInstance %s created; training starts", instance_id)
 
-    def _stamp(status: str) -> EngineInstance:
+    def _stamp(status: str, **extra) -> EngineInstance:
         """Final status flip over the FRESHEST record, so the
-        heartbeat's last_heartbeat/attempt stamps survive."""
+        heartbeat's last_heartbeat/attempt stamps survive. ``extra``
+        fields (e.g. the phase-time breakdown) ride the same write."""
         cur = meta.engine_instance_get(instance_id) or dataclasses.replace(
             instance, id=instance_id)
-        done = dataclasses.replace(cur, status=status, end_time=_now())
+        done = dataclasses.replace(cur, status=status, end_time=_now(), **extra)
         meta.engine_instance_update(done)
         return done
 
@@ -298,8 +299,11 @@ def run_train(
                 cur, last_heartbeat=iso, attempt=attempt))
 
     def _body() -> tuple[int, int]:
-        from .tracing import maybe_profile, phase_report
+        from .tracing import maybe_profile, phase_report, reset_phases
 
+        # each supervised attempt re-runs every phase; without the reset
+        # a retried run's persisted breakdown would double-count
+        reset_phases(ctx)
         with maybe_profile(getattr(ctx, "profile_dir", None)):
             result = engine.train(ctx, engine_params)
         log.info("training phases: %s", phase_report(ctx))
@@ -320,7 +324,9 @@ def run_train(
     )
     try:
         n_models, n_bytes = supervisor.run(_body)
-        _stamp("COMPLETED")
+        from .tracing import phase_times_json
+
+        _stamp("COMPLETED", phase_times=phase_times_json(ctx))
         log.info("Training completed: instance %s (%d model(s), %d bytes, "
                  "%d attempt(s))",
                  instance_id, n_models, n_bytes, supervisor.attempts)

@@ -58,6 +58,9 @@ from aiohttp import web
 
 from ..controller.engine import Engine, TrainResult
 from ..controller.params import parse_params
+from ..obs.http import handle_metrics
+from ..obs.metrics import METRICS
+from ..obs.trace import TRACE_HEADER, ensure_request_id, trace_event
 from ..storage import EngineInstance, Storage
 from .faults import FAULTS
 from .feedback import FeedbackPublisher
@@ -68,6 +71,26 @@ from .core_workflow import prepare_deploy
 log = logging.getLogger("predictionio_tpu.server")
 
 __all__ = ["EngineServer", "create_engine_server_app", "run_engine_server"]
+
+# ISSUE 5: the query plane's registry handles. The serving histogram is
+# end-to-end (parse -> dispatch -> feedback fan-out), i.e. what the
+# client experienced, not just device time (microbatch.py records the
+# inner stages separately).
+_M_SERVE = METRICS.histogram(
+    "pio_serving_latency_seconds",
+    "end-to-end POST /queries.json latency as the client saw it")
+_M_QUERIES = METRICS.counter(
+    "pio_queries_total",
+    "queries by outcome (ok/bad_request/busy/deadline/watchdog/draining)",
+    labelnames=("status",))
+_M_DEGRADED = METRICS.gauge(
+    "pio_degraded_mode",
+    "1 while the engine server serves on the degraded fallback path")
+# same family microbatch.py counts on its paths — the fallback path's
+# expiries must not vanish from the counter just because batching is off
+_M_DEADLINE = METRICS.counter(
+    "pio_deadline_expired_total",
+    "queries answered 504 because their end-to-end deadline expired")
 
 
 def _to_jsonable(x: Any) -> Any:
@@ -255,6 +278,7 @@ class EngineServer:
         if not self.degraded:
             self.degraded = True
             self.degraded_since = datetime.now(timezone.utc).isoformat()
+            _M_DEGRADED.set(1)
             if self.batcher is not None:
                 self.batcher.set_max_inflight(
                     max(1, self.batcher.max_inflight // 2))
@@ -271,6 +295,7 @@ class EngineServer:
         self.degraded = False
         self.degraded_since = None
         self._probe_at = None
+        _M_DEGRADED.set(0)
         if self.batcher is not None:
             self.batcher.set_max_inflight(self._inflight_configured)
 
@@ -328,6 +353,7 @@ class EngineServer:
         if deadline is not None:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
+                _M_DEADLINE.inc()
                 raise DeadlineExceeded("request deadline expired")
             timeout = min(timeout, remaining) if timeout else remaining
         work = asyncio.to_thread(self.serve_query, query_json)
@@ -337,6 +363,7 @@ class EngineServer:
             return await asyncio.wait_for(work, timeout)
         except asyncio.TimeoutError:
             if deadline is not None and time.monotonic() >= deadline:
+                _M_DEADLINE.inc()
                 raise DeadlineExceeded(
                     "request deadline expired during serving") from None
             raise DispatchTimeout(
@@ -575,8 +602,20 @@ class EngineServer:
                 "avgServingSec": self.avg_serving_sec,
                 "lastServingSec": self.last_serving_sec,
             }
+        def _hist(name: str):
+            h = METRICS.get(name)
+            return h.snapshot() if h is not None else None
+
         return {
             **counters,
+            # thin view over the obs registry: the same histograms
+            # /metrics exports, as count/sum/p50/p95/p99 (seconds)
+            "latency": {
+                "serving": _hist("pio_serving_latency_seconds"),
+                "queueWait": _hist("pio_microbatch_queue_wait_seconds"),
+                "dispatch": _hist("pio_microbatch_dispatch_seconds"),
+                "device": _hist("pio_microbatch_device_seconds"),
+            },
             "batching": self.batcher.stats() if self.batcher else None,
             "execCache": EXEC_CACHE.stats(),
             "resilience": {
@@ -602,34 +641,50 @@ SERVER_KEY = web.AppKey("engine_server", EngineServer)
 
 async def handle_query(request: web.Request) -> web.Response:
     server: EngineServer = request.app[SERVER_KEY]
+    # trace ingress: adopt the client's X-PIO-Request-ID or mint one;
+    # the contextvar follows the request through the micro-batcher and
+    # into the feedback event (pio_request_id), and every response
+    # echoes the id so the client can quote it back
+    rid = ensure_request_id(request.headers.get(TRACE_HEADER))
+    t0 = time.perf_counter()
+
+    def _done(status_label: str, body: dict, status: int = 200) -> web.Response:
+        _M_SERVE.record(time.perf_counter() - t0)
+        _M_QUERIES.inc(status=status_label)
+        trace_event("serve.ingress", status=status_label,
+                    http=status, ms=round((time.perf_counter() - t0) * 1e3, 3))
+        return web.json_response(body, status=status,
+                                 headers={TRACE_HEADER: rid})
+
     if server.draining:
-        return web.json_response(
-            {"message": "Server is draining; not accepting queries."},
-            status=503)
+        return _done("draining",
+                     {"message": "Server is draining; not accepting queries."},
+                     503)
     try:
         query_json = await request.json()
     except (json.JSONDecodeError, UnicodeDecodeError):
-        return web.json_response({"message": "Malformed JSON body."}, status=400)
+        return _done("bad_request", {"message": "Malformed JSON body."}, 400)
     if not isinstance(query_json, dict):
-        return web.json_response({"message": "Query must be a JSON object."}, status=400)
+        return _done("bad_request",
+                     {"message": "Query must be a JSON object."}, 400)
     try:
         result = await server.dispatch_query(
             query_json, deadline=server.request_deadline(request))
     except DeadlineExceeded as e:
-        return web.json_response({"message": str(e)}, status=504)
+        return _done("deadline", {"message": str(e)}, 504)
     except DispatchTimeout as e:
-        return web.json_response({"message": str(e)}, status=504)
+        return _done("watchdog", {"message": str(e)}, 504)
     except ServerBusy as e:
-        return web.json_response({"message": str(e)}, status=503)
+        return _done("busy", {"message": str(e)}, 503)
     except Exception as e:  # noqa: BLE001 — surface as 400 like the reference
         log.exception("query failed")
-        return web.json_response({"message": str(e)}, status=400)
+        return _done("error", {"message": str(e)}, 400)
     if server.feedback is not None:
         pr_id = uuid.uuid4().hex
         result_with_pr = {**result, "prId": pr_id} if isinstance(result, dict) else result
-        server.feedback.publish(query_json, result, pr_id)
-        return web.json_response(result_with_pr)
-    return web.json_response(result)
+        server.feedback.publish(query_json, result, pr_id, request_id=rid)
+        return _done("ok", result_with_pr)
+    return _done("ok", result)
 
 
 def _status_html(s: dict) -> str:
@@ -710,6 +765,7 @@ def create_engine_server_app(server: EngineServer) -> web.Application:
     app.router.add_post("/queries.json", handle_query)
     app.router.add_get("/", handle_status)
     app.router.add_get("/stats.json", handle_stats_json)
+    app.router.add_get("/metrics", handle_metrics)
     app.router.add_get("/health.json", handle_health)
     app.router.add_get("/reload", handle_reload)
     app.router.add_get("/stop", handle_stop)

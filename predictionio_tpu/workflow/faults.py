@@ -62,7 +62,36 @@ import asyncio
 import threading
 import time
 
-__all__ = ["FaultInjected", "FaultSpec", "FaultInjector", "FAULTS"]
+from ..obs.metrics import METRICS
+
+__all__ = ["FaultInjected", "FaultSpec", "FaultInjector", "FAULTS", "SITES"]
+
+#: every named injection site in the codebase — the docstring above
+#: documents each; keep the two lists and docs/operations.md in sync
+#: (tests/test_train_supervision.py and tests/test_observability.py
+#: guard both)
+SITES: tuple[str, ...] = (
+    "microbatch.dispatch",
+    "retrieval.topk",
+    "server.serve_batch",
+    "server.feedback",
+    "eventserver.insert",
+    "journal.append",
+    "journal.fsync",
+    "eventserver.drain",
+    "train.step",
+    "train.persist",
+)
+
+#: chaos runs must always be measurable: one counter series per site,
+#: pre-registered at import so `/metrics` shows a zero before the first
+#: firing instead of a missing family
+_M_FAULTS = METRICS.counter(
+    "faults_injected_total",
+    "fault-injection firings by site (workflow/faults.py)",
+    labelnames=("site",))
+for _site in SITES:
+    _M_FAULTS.labels(site=_site).inc(0)
 
 
 class FaultInjected(RuntimeError):
@@ -179,7 +208,8 @@ class FaultInjector:
                     # disarm now; threads already inside keep their spec
                     self._armed.pop(site, None)
             self._fired[site] = self._fired.get(site, 0) + 1
-            return spec
+        _M_FAULTS.labels(site=site).inc()
+        return spec
 
     def fire(self, site: str) -> None:
         """Synchronous site (worker thread / sync handler). No-op unless

@@ -32,11 +32,24 @@ import random
 import time
 from collections import deque
 
+from ..obs.breaker import breaker_set as _breaker_set
+from ..obs.metrics import METRICS
+from ..obs.trace import current_request_id, trace_event
 from .faults import FAULTS
 
 log = logging.getLogger("predictionio_tpu.server")
 
 __all__ = ["FeedbackPublisher"]
+
+# ISSUE 5: breaker state/transition gauges live in obs/breaker.py
+# (shared with the ingest drainer); these two are feedback-specific
+_M_RETRY_DEPTH = METRICS.gauge(
+    "pio_feedback_retry_depth",
+    "feedback events waiting in the bounded retry queue")
+_M_FEEDBACK = METRICS.counter(
+    "pio_feedback_events_total",
+    "feedback publishes by outcome (sent/failed/retried/dropped)",
+    labelnames=("outcome",))
 
 
 class FeedbackPublisher:
@@ -92,6 +105,7 @@ class FeedbackPublisher:
         if self._state == "open":
             if now - self._opened_at >= self.breaker_reset_s:
                 self._state = "half_open"
+                _breaker_set("feedback", "half_open", prev="open")
                 return True
             return False
         return False  # half_open: probe outstanding
@@ -99,18 +113,22 @@ class FeedbackPublisher:
     def _on_success(self) -> None:
         if self._state != "closed":
             log.info("feedback breaker closed (probe succeeded)")
+            _breaker_set("feedback", "closed", prev=self._state)
         self._state = "closed"
         self._consecutive_failures = 0
         self.sent += 1
+        _M_FEEDBACK.inc(outcome="sent")
 
     def _on_failure(self, err: Exception) -> None:
         self.failed += 1
+        _M_FEEDBACK.inc(outcome="failed")
         self._consecutive_failures += 1
         if self._state == "half_open" or (
                 self._state == "closed"
                 and self._consecutive_failures >= self.breaker_threshold):
             if self._state != "open":
                 self.breaker_opens += 1
+                _breaker_set("feedback", "open", prev=self._state)
                 log.warning(
                     "feedback breaker OPEN after %d consecutive failures "
                     "(last: %s); dropping feedback for %.1fs",
@@ -119,23 +137,35 @@ class FeedbackPublisher:
             self._opened_at = time.monotonic()
 
     # -- publish path ------------------------------------------------------
-    def publish(self, query_json: dict, prediction, pr_id: str) -> None:
+    def publish(self, query_json: dict, prediction, pr_id: str,
+                request_id: str | None = None) -> None:
         """Fire-and-forget from the query hot path; the task is tracked
         so drain can cancel/await it. Breaker-open publishes drop
-        immediately (counted) instead of queuing against a dead server."""
+        immediately (counted) instead of queuing against a dead server.
+
+        ``request_id`` (default: the context's trace id) is stamped into
+        the event as a ``pio_request_id`` property, so the event-store
+        row joins back to the serving log line that produced it."""
         if self._closing:
             self.dropped += 1
+            _M_FEEDBACK.inc(outcome="dropped")
             return
+        rid = request_id or current_request_id()
+        props = {"query": query_json, "prediction": prediction}
+        if rid:
+            props["pio_request_id"] = rid
         event = {
             "event": "predict",
             "entityType": "pio_pr",
             "entityId": pr_id,
-            "properties": {"query": query_json, "prediction": prediction},
+            "properties": props,
             "prId": pr_id,
         }
         if not self._breaker_allows(time.monotonic()):
             self.dropped += 1
+            _M_FEEDBACK.inc(outcome="dropped")
             return
+        trace_event("serve.feedback_publish", trace=rid, pr_id=pr_id)
         self._track(asyncio.create_task(self._post(event, attempt=0)))
 
     def _track(self, task: asyncio.Task) -> None:
@@ -181,16 +211,19 @@ class FeedbackPublisher:
     def _enqueue_retry(self, event: dict, attempt: int) -> None:
         if attempt > self.retry_max:
             self.dropped += 1
+            _M_FEEDBACK.inc(outcome="dropped")
             return
         if len(self._retry) >= self.queue_max:
             self._retry.popleft()  # oldest out: the queue is a buffer,
             self.dropped += 1      # not an archive
+            _M_FEEDBACK.inc(outcome="dropped")
         backoff = min(self.backoff_cap_s,
                       self.backoff_base_s * (2 ** (attempt - 1)))
         # full jitter: desynchronizes a thundering herd of retries when
         # the event server comes back
         delay = backoff * (0.5 + random.random() / 2)
         self._retry.append((event, attempt, time.monotonic() + delay))
+        _M_RETRY_DEPTH.set(len(self._retry))
         self._ensure_worker()
         if self._retry_wake is not None:
             self._retry_wake.set()
@@ -220,6 +253,8 @@ class FeedbackPublisher:
                 if not_before <= now:
                     del self._retry[i]
                     self.retried += 1
+                    _M_FEEDBACK.inc(outcome="retried")
+                    _M_RETRY_DEPTH.set(len(self._retry))
                     await self._post(event, attempt)
                     break
 

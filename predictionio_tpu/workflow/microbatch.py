@@ -46,11 +46,36 @@ import logging
 import time
 from typing import Any, Callable, Sequence
 
+from ..obs.metrics import METRICS
+from ..obs.trace import current_request_id, trace_event
 from .faults import FAULTS
 
 log = logging.getLogger("predictionio_tpu.server")
 
 __all__ = ["MicroBatcher", "ServerBusy", "DeadlineExceeded", "DispatchTimeout"]
+
+# ISSUE 5: the micro-batch hot sites, dark since PR 1/2, now land in the
+# process registry. Instance counters below stay the per-batcher view
+# (stats()/tests); these are the cross-process-scrape view.
+_M_QUEUE_WAIT = METRICS.histogram(
+    "pio_microbatch_queue_wait_seconds",
+    "time a query waits in the micro-batch queue before batch formation")
+_M_WINDOW = METRICS.histogram(
+    "pio_microbatch_window_seconds",
+    "coalescing window chosen per formed batch (adaptive: EWMA-scaled)")
+_M_DISPATCH = METRICS.histogram(
+    "pio_microbatch_dispatch_seconds",
+    "wall time of one batched dispatch (thread hop + device call)")
+_M_DEVICE = METRICS.histogram(
+    "pio_microbatch_device_seconds",
+    "batch_fn execution inside the dispatch worker thread (device time)")
+_M_DEADLINE = METRICS.counter(
+    "pio_deadline_expired_total",
+    "queries failed 504 because their end-to-end deadline passed")
+_M_WATCHDOG = METRICS.counter(
+    "pio_watchdog_reclaims_total",
+    "stuck-dispatch watchdog trips (pipeline slot reclaimed, thread "
+    "zombied)")
 
 
 class ServerBusy(RuntimeError):
@@ -107,8 +132,9 @@ class MicroBatcher:
         self._ewma_iv: float | None = None
         self._last_arrival: float | None = None
         self.last_window_s = 0.0 if adaptive else self.window_s
-        #: (query, future, absolute-monotonic deadline | None)
-        self._pending: list[tuple[Any, asyncio.Future, float | None]] = []
+        #: (query, future, absolute-monotonic deadline | None,
+        #:  enqueue instant, trace id | None)
+        self._pending: list[tuple] = []
         self._wake: asyncio.Event | None = None
         self._task: asyncio.Task | None = None
         self._sem: asyncio.Semaphore | None = None
@@ -147,6 +173,8 @@ class MicroBatcher:
             raise ServerBusy("micro-batcher is shutting down")
         if deadline is not None and time.monotonic() >= deadline:
             self.deadline_expired += 1
+            _M_DEADLINE.inc()
+            trace_event("serve.deadline_expired", where="submit")
             raise DeadlineExceeded("request deadline expired before submit")
         if len(self._pending) >= self.max_pending:
             raise ServerBusy(
@@ -155,7 +183,8 @@ class MicroBatcher:
         if self.adaptive:
             self._note_arrival(time.monotonic())
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._pending.append((query, fut, deadline))
+        self._pending.append(
+            (query, fut, deadline, time.monotonic(), current_request_id()))
         assert self._wake is not None
         self._wake.set()
         return await fut
@@ -242,7 +271,7 @@ class MicroBatcher:
             # fail anything still queued — a caller awaiting submit() must
             # not hang forever because shutdown won the race with its batch
             pending, self._pending = self._pending, []
-            for _, fut, _ in pending:
+            for _, fut, *_rest in pending:
                 if not fut.done():
                     fut.set_exception(asyncio.CancelledError("batcher closed"))
         finally:
@@ -251,17 +280,22 @@ class MicroBatcher:
     def _sweep_expired(self, now: float) -> None:
         """Fail queued queries whose deadline passed (504) so they never
         consume a batch slot; runs at every batch-formation point."""
-        if not any(d is not None and d <= now for _, _, d in self._pending):
+        if not any(t[2] is not None and t[2] <= now for t in self._pending):
             return
-        keep: list[tuple[Any, asyncio.Future, float | None]] = []
-        for query, fut, dl in self._pending:
+        keep: list[tuple] = []
+        for item in self._pending:
+            query, fut, dl, t_enq, rid = item
             if dl is not None and dl <= now:
                 self.deadline_expired += 1
+                _M_DEADLINE.inc()
+                trace_event("serve.deadline_expired", trace=rid,
+                            where="queued",
+                            waited_ms=round((now - t_enq) * 1e3, 3))
                 if not fut.done():
                     fut.set_exception(DeadlineExceeded(
                         "request deadline expired while queued"))
             else:
-                keep.append((query, fut, dl))
+                keep.append(item)
         self._pending[:] = keep
 
     async def _run(self) -> None:
@@ -272,6 +306,7 @@ class MicroBatcher:
             await self._wake.wait()
             w = self._choose_window(time.monotonic())
             self.last_window_s = w
+            _M_WINDOW.record(w)
             if w > 0 and len(self._pending) < self.max_batch:
                 # window open: let concurrent requests pile in
                 await asyncio.sleep(w)
@@ -302,7 +337,11 @@ class MicroBatcher:
         error/slow site for 'a device call wedged' lives here so an
         injected hang occupies the thread exactly like a real one."""
         FAULTS.fire("microbatch.dispatch")
-        return self.batch_fn(queries)
+        t0 = time.perf_counter()
+        try:
+            return self.batch_fn(queries)
+        finally:
+            _M_DEVICE.record(time.perf_counter() - t0)
 
     def _zombie_done(self, task: asyncio.Task) -> None:
         self._zombies -= 1
@@ -324,8 +363,13 @@ class MicroBatcher:
         is tracked as a zombie until it returns."""
         self._live += 1
         self.peak_inflight = max(self.peak_inflight, self._live)
+        t_start = time.monotonic()
+        traces = [t[4] for t in batch if len(t) > 4 and t[4]]
+        for t in batch:
+            if len(t) > 3:
+                _M_QUEUE_WAIT.record(t_start - t[3])
         try:
-            queries = [q for q, _, _ in batch]
+            queries = [t[0] for t in batch]
             inner = asyncio.ensure_future(
                 asyncio.to_thread(self._call_batch_fn, queries))
             try:
@@ -342,6 +386,10 @@ class MicroBatcher:
                         f"{len(batch)} queries")
             except asyncio.TimeoutError:
                 self.watchdog_trips += 1
+                _M_WATCHDOG.inc()
+                trace_event("serve.watchdog_reclaim", trace=None,
+                            traces=traces, batch=len(batch),
+                            timeout_s=self.dispatch_timeout_s)
                 self._zombies += 1
                 inner.add_done_callback(self._zombie_done)
                 log.error(
@@ -352,7 +400,7 @@ class MicroBatcher:
                 err = DispatchTimeout(
                     f"batch dispatch exceeded {self.dispatch_timeout_s}s "
                     f"watchdog; slot reclaimed")
-                for _, fut, _ in batch:
+                for _, fut, *_rest in batch:
                     if not fut.done():
                         fut.set_exception(err)
                 if self.on_watchdog is not None:
@@ -362,14 +410,18 @@ class MicroBatcher:
                         log.exception("on_watchdog hook failed")
                 return
             except Exception as e:  # noqa: BLE001 — batch-level failure
-                for _, fut, _ in batch:
+                for _, fut, *_rest in batch:
                     if not fut.done():
                         fut.set_exception(e)
                 return
             self.batches += 1
             self.batched_queries += len(batch)
             self.max_seen_batch = max(self.max_seen_batch, len(batch))
-            for (_, fut, _), (tag, payload) in zip(batch, outcomes):
+            dispatch_s = time.monotonic() - t_start
+            _M_DISPATCH.record(dispatch_s)
+            trace_event("serve.dispatch", trace=None, traces=traces,
+                        batch=len(batch), ms=round(dispatch_s * 1e3, 3))
+            for (_, fut, *_rest), (tag, payload) in zip(batch, outcomes):
                 if fut.done():
                     continue
                 if tag == "ok":

@@ -14,12 +14,33 @@ profiler (SURVEY.md §5). The TPU build surfaces the equivalents natively:
 from __future__ import annotations
 
 import contextlib
+import json
 import logging
 import time
 
 log = logging.getLogger("predictionio_tpu.workflow")
 
-__all__ = ["maybe_profile", "phase_timer", "phase_report"]
+__all__ = [
+    "maybe_profile", "phase_timer", "phase_report", "reset_phases",
+    "phase_times_json",
+]
+
+
+def reset_phases(ctx) -> None:
+    """Start a run (or a supervised RETRY attempt) with a clean slate.
+
+    ``phase_times`` accumulates on the Context object; a retried attempt
+    re-runs every phase, so without this reset the breakdown would
+    double-count and the persisted record would blame phases for time
+    they never spent in the successful attempt."""
+    ctx.phase_times = []
+
+
+def phase_times_json(ctx) -> str:
+    """The phase breakdown as a compact JSON list of [phase, seconds]
+    pairs — the shape persisted into the EngineInstance record."""
+    times = getattr(ctx, "phase_times", None) or []
+    return json.dumps([[p, round(dt, 6)] for p, dt in times])
 
 
 @contextlib.contextmanager

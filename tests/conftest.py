@@ -103,6 +103,19 @@ def clean_storage():
     Storage.reset()
 
 
+@pytest.fixture(autouse=True)
+def _reset_metrics():
+    """Zero the process-wide telemetry registry between tests. reset()
+    zeroes values IN PLACE, so the metric handles subsystems captured at
+    import time stay valid — a test asserting on a counter always starts
+    from 0 without re-importing the world."""
+    from predictionio_tpu.obs.metrics import METRICS
+
+    METRICS.reset()
+    yield
+    METRICS.reset()
+
+
 @pytest.fixture(scope="session")
 def mesh8():
     from predictionio_tpu.parallel.mesh import make_mesh
