@@ -1158,7 +1158,7 @@ def main() -> None:
     import threading
 
     state = {"value": 0.0, "vs": 0.0, "cdt": "", "platform": "",
-             "gap": 0.0, "result": {}, "extras": {}}
+             "invalid": False, "gap": 0.0, "result": {}, "extras": {}}
     # one lock serializes main-thread state updates against the
     # watchdog's emit — without it a deadline firing mid-update could
     # crash emit() on a mutating dict and lose the partial artifact
@@ -1174,14 +1174,19 @@ def main() -> None:
                       for k, v in state["extras"].items()}
             value, vs = state["value"], state["vs"]
             cdt, platform, gap = state["cdt"], state["platform"], state["gap"]
+            invalid = state["invalid"]
         if wedged_in:
             extras["partial"] = (f"platform wedged during {wedged_in!r}; "
                                  f"artifact holds the phases that finished")
-        print(json.dumps({
+        row = {
             "metric": "als_train_iters_per_sec_ml20m_rank64",
             "value": round(value, 3),
             "unit": "iters/sec/chip",
             "vs_baseline": round(vs, 2),
+            # platform at top level: a reader (or the driver) must not
+            # have to dig into config to learn what substrate produced
+            # the number it is about to compare against TPU baselines
+            "platform": platform,
             "config": {"compute_dtype": cdt, "solver": "cg",
                        "platform": platform,
                        "accuracy_gap_rmse": round(gap, 6),
@@ -1189,46 +1194,72 @@ def main() -> None:
                           ("hbm_gbps", "hbm_util_pct", "traffic_gb_per_iter")
                           if k in result},
                        "floor_config": "float32/cg", **extras},
-        }), flush=True)
+        }
+        if invalid:
+            # the run did NOT execute on the platform it was asked for
+            # (PIO_BENCH_PLATFORM, default tpu) — numbers are labeled
+            # but must never be ingested as baseline-comparable
+            row["invalid"] = True
+        print(json.dumps(row), flush=True)
 
     import atexit
 
     atexit.register(kill_children)
     wd = Watchdog(emit)
-    platform = "tpu"
-    # r4 post-mortem: 4 x (180 s probe + 300 s sleep) burned ~27 min of
-    # the driver budget before the CPU fallback even started -> rc 124
-    # with no artifact. Keep the schedule inside ~3 x 60 s total.
-    for attempt in range(3):
-        if device_healthy(timeout_s=60):
-            break
-        log(f"accelerator probe failed (attempt {attempt + 1}/3)")
-        if attempt < 2:
-            log("retrying in 45s")
-            time.sleep(45)
-    else:
-        # the artifact must not be empty OR a silent hang: run the whole
-        # bench on the host CPU at reduced scale, clearly labeled
-        log("accelerator unreachable — falling back to a LABELED CPU run "
-            "(single device, reduced scale); the value below is NOT a "
-            "TPU number")
-        platform = "cpu-fallback"
+
+    def _pin_host_cpu():
+        """Single-device host backend for THIS process only (config, not
+        env: children — floor, sharding, ingest — must not inherit a
+        platform meant for this process). SINGLE device, matching the
+        cpu floor's convention: timing the in-process run on an 8-wide
+        virtual mesh made vs_baseline report the virtualization overhead
+        (measured 0.5x on a 1-core host), not information — the
+        multi-device program is exercised by the factor-sharding child on
+        its own virtual mesh either way. An inherited force-flag (the
+        repo's test/verify recipe exports one) would silently re-widen
+        this process's mesh at backend init — strip it; the virtual-mesh
+        children re-add their own."""
         import jax
 
-        # config, not env: children (floor, sharding, ingest) must not
-        # inherit a platform meant for this process only. SINGLE device,
-        # matching the cpu floor's convention: timing the in-process run
-        # on an 8-wide virtual mesh made vs_baseline report the
-        # virtualization overhead (measured 0.5x on a 1-core host), not
-        # information — the multi-device program is exercised by the
-        # factor-sharding child on its own virtual mesh either way.
-        # An inherited force-flag (the repo's test/verify recipe exports
-        # one) would silently re-widen this process's mesh at backend
-        # init — strip it; the virtual-mesh children re-add their own.
         os.environ["XLA_FLAGS"] = re.sub(
             r"--xla_force_host_platform_device_count=\d+", "",
             os.environ.get("XLA_FLAGS", "")).strip()
         jax.config.update("jax_platforms", "cpu")
+
+    # the platform this run is REQUIRED to produce numbers on. A run that
+    # lands anywhere else is emitted labeled AND marked invalid, and the
+    # process exits nonzero — a silent cpu-fallback row must never be
+    # ingested as a TPU baseline point (the satellite this PR closes).
+    requested = os.environ.get("PIO_BENCH_PLATFORM", "tpu").strip().lower()
+    if requested == "cpu":
+        # an explicitly requested CPU run is VALID (labeled "cpu", not
+        # "cpu-fallback"): skip the accelerator probe entirely
+        log("PIO_BENCH_PLATFORM=cpu — pinned host-CPU run (single "
+            "device, reduced scale)")
+        platform = "cpu"
+        _pin_host_cpu()
+    else:
+        platform = "tpu"
+        # r4 post-mortem: 4 x (180 s probe + 300 s sleep) burned ~27 min
+        # of the driver budget before the CPU fallback even started ->
+        # rc 124 with no artifact. Keep the schedule inside ~3 x 60 s.
+        for attempt in range(3):
+            if device_healthy(timeout_s=60):
+                break
+            log(f"accelerator probe failed (attempt {attempt + 1}/3)")
+            if attempt < 2:
+                log("retrying in 45s")
+                time.sleep(45)
+        else:
+            # the artifact must not be empty OR a silent hang: run the
+            # whole bench on the host CPU at reduced scale, clearly
+            # labeled and marked invalid
+            log("accelerator unreachable — falling back to a LABELED CPU "
+                "run (single device, reduced scale); the value below is "
+                "NOT a TPU number and the artifact is marked invalid")
+            platform = "cpu-fallback"
+            _pin_host_cpu()
+    state["invalid"] = platform != requested
     enable_compile_cache()
     # bf16 is EMULATED on CPU (an order of magnitude slower than f32
     # there); each substrate runs its natural best configuration, and the
@@ -1381,6 +1412,13 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — floor is informative, not load-bearing
         log(f"cpu floor unavailable: {e}")
     emit()
+    if state["invalid"]:
+        # the artifact (already emitted, labeled + "invalid": true) is
+        # preserved for diagnosis, but the exit code tells the driver the
+        # run must not update baselines
+        log(f"bench ran on {state['platform']!r} but {requested!r} was "
+            f"requested — exiting 3 (artifact marked invalid)")
+        sys.exit(3)
 
 
 if __name__ == "__main__":

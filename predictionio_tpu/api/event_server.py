@@ -51,6 +51,7 @@ from ..storage import (
 from ..storage.event import _dt_from_wire
 from ..storage.events_base import StorageError, TableNotInitialized
 from ..storage.journal import JournalFull
+from ..workflow.admission import AdmissionController
 from ..workflow.faults import FAULTS
 from .ingest import DurableIngestor
 from .stats import Stats
@@ -62,17 +63,19 @@ __all__ = ["create_event_app", "run_event_server", "AuthData"]
 
 STATS_KEY = web.AppKey("stats", object)
 INGEST_KEY = web.AppKey("ingest", object)
+ADMISSION_KEY = web.AppKey("admission", object)
 
-#: Retry-After seconds on journal-full 503s — long enough for the
-#: drainer to free a segment, short enough that clients probe a
-#: recovering server promptly.
+#: FALLBACK Retry-After seconds on journal-full 503s, used only before
+#: the drainer has any throughput history; once it does, the header is
+#: computed dynamically from journal lag / drain rate
+#: (DurableIngestor.retry_after_s, via the shared admission helper).
 BACKPRESSURE_RETRY_AFTER_S = 1
 
 # ISSUE 5: every booked ingest outcome, by HTTP status — the scrapeable
 # twin of the per-app Stats bookkeeping (which stays hourly/per-app)
 _M_EVENTS = METRICS.counter(
     "pio_events_ingested_total",
-    "ingest outcomes by HTTP status (201/400/401/403/500/503)",
+    "ingest outcomes by HTTP status (201/400/401/403/429/500/503)",
     labelnames=("status",))
 
 
@@ -218,15 +221,40 @@ async def _insert_event_dict(
     return await _insert_one(request, auth, validated)
 
 
-def _ingest_response(status: int, body) -> web.Response:
+def _ingest_response(request: web.Request, status: int, body) -> web.Response:
     """json_response + the backpressure contract: every 503 (or batch
     containing one) carries Retry-After so well-behaved clients pace
-    themselves instead of hammering a full journal."""
+    themselves instead of hammering a full journal. The delay is
+    lag-proportional (journal lag / drain rate, jittered) once the
+    drainer has throughput history; a fixed fallback before that."""
     full = status == 503 or (
         isinstance(body, list)
         and any(isinstance(x, dict) and x.get("status") == 503 for x in body))
-    headers = {"Retry-After": str(BACKPRESSURE_RETRY_AFTER_S)} if full else None
+    headers = None
+    if full:
+        ingest: DurableIngestor | None = request.app.get(INGEST_KEY)
+        ra = (ingest.retry_after_s() if ingest is not None
+              else float(BACKPRESSURE_RETRY_AFTER_S))
+        headers = {"Retry-After": f"{max(0.0, ra):.3f}"}
     return web.json_response(body, status=status, headers=headers)
+
+
+def _admission_check(request: web.Request, auth: AuthData) -> web.Response | None:
+    """Adaptive admission for the ingest write paths (ISSUE 6): sheds
+    429 + Retry-After off journal pressure / per-access-key token
+    buckets BEFORE the validate + journal-append work is spent. Returns
+    the 429 response, or None to admit."""
+    adm: AdmissionController | None = request.app.get(ADMISSION_KEY)
+    if adm is None:
+        return None
+    decision = adm.decide("ingest", key=request.query.get("accessKey"))
+    if decision.admitted:
+        return None
+    _bump_stats(request, auth.app_id, 429)
+    return web.json_response(
+        {"message": f"overloaded; retry later ({decision.reason})"},
+        status=429,
+        headers={"Retry-After": f"{max(0.0, decision.retry_after_s):.3f}"})
 
 
 # -- handlers ---------------------------------------------------------------
@@ -243,6 +271,10 @@ async def handle_post_event(request: web.Request) -> web.Response:
     auth = await _authenticate(request, ingest=True)
     if isinstance(auth, web.Response):
         return auth
+    shed = _admission_check(request, auth)
+    if shed is not None:
+        shed.headers[TRACE_HEADER] = rid
+        return shed
     try:
         data = await request.json()
     except (json.JSONDecodeError, UnicodeDecodeError):
@@ -251,7 +283,7 @@ async def handle_post_event(request: web.Request) -> web.Response:
     status, body = await _insert_event_dict(request, auth, data)
     trace_event("ingest.ingress", status=status,
                 event_id=body.get("eventId") if isinstance(body, dict) else None)
-    resp = _ingest_response(status, body)
+    resp = _ingest_response(request, status, body)
     resp.headers[TRACE_HEADER] = rid
     return resp
 
@@ -264,6 +296,10 @@ async def handle_post_batch(request: web.Request) -> web.Response:
     auth = await _authenticate(request, ingest=True)
     if isinstance(auth, web.Response):
         return auth
+    shed = _admission_check(request, auth)
+    if shed is not None:
+        shed.headers[TRACE_HEADER] = rid
+        return shed
     try:
         data = await request.json()
     except (json.JSONDecodeError, UnicodeDecodeError):
@@ -359,7 +395,7 @@ async def handle_post_batch(request: web.Request) -> web.Response:
     trace_event("ingest.ingress", batch=len(data),
                 accepted=sum(1 for r in results
                              if r and r.get("status") == 201))
-    resp = _ingest_response(200, results)
+    resp = _ingest_response(request, 200, results)
     resp.headers[TRACE_HEADER] = rid
     return resp
 
@@ -459,6 +495,9 @@ async def handle_stats(request: web.Request) -> web.Response:
         # journal/drain counters are server-wide (one journal serves every
         # app), reported alongside the per-app ingest bookkeeping
         body["ingest"] = ingest.stats()
+    adm: AdmissionController | None = request.app.get(ADMISSION_KEY)
+    if adm is not None:
+        body["admission"] = adm.stats()
     return web.json_response(body)
 
 
@@ -481,6 +520,9 @@ async def handle_webhook_post(request: web.Request) -> web.Response:
     auth = await _authenticate(request, ingest=True)
     if isinstance(auth, web.Response):
         return auth
+    shed = _admission_check(request, auth)
+    if shed is not None:
+        return shed
     name = request.match_info["name"]
     is_json = name.endswith(".json")
     connector = get_connector(name[:-5] if is_json else name)
@@ -504,7 +546,7 @@ async def handle_webhook_post(request: web.Request) -> web.Response:
         _bump_stats(request, auth.app_id, 400)
         return _json_error(400, "Malformed body.")
     status, body = await _insert_event_dict(request, auth, event_json)
-    return _ingest_response(status, body)
+    return _ingest_response(request, status, body)
 
 
 async def handle_webhook_get(request: web.Request) -> web.Response:
@@ -521,13 +563,18 @@ async def handle_webhook_get(request: web.Request) -> web.Response:
 
 
 def create_event_app(stats: bool = False,
-                     ingestor: DurableIngestor | None = None) -> web.Application:
+                     ingestor: DurableIngestor | None = None,
+                     admission: AdmissionController | None = None,
+                     ) -> web.Application:
     """``ingestor`` switches the write path to durable journal-acked
     mode; its lifecycle (startup replay, background drainer, final
-    fsync) rides the app's startup/cleanup signals."""
+    fsync) rides the app's startup/cleanup signals. ``admission``
+    enables 429 shedding (journal pressure + per-key rate limits) on
+    the write endpoints."""
     app = web.Application()
     app[STATS_KEY] = Stats() if stats else None
     app[INGEST_KEY] = ingestor
+    app[ADMISSION_KEY] = admission
     app.router.add_get("/", handle_root)
     app.router.add_post("/events.json", handle_post_event)
     app.router.add_post("/batch/events.json", handle_post_batch)
@@ -556,10 +603,15 @@ def create_event_app(stats: bool = False,
 def run_event_server(ip: str = "0.0.0.0", port: int = 7070,
                      stats: bool = False, journal_dir: str | None = None,
                      journal_fsync: str = "batch",
-                     journal_max_mb: int = 256) -> None:
+                     journal_max_mb: int = 256,
+                     admission: bool = False,
+                     rate_limit_qps: float = 0.0,
+                     rate_limit_burst: float = 0.0) -> None:
     """Blocking entry (reference: EventServer.createEventServer,
     EventAPI.scala:449-468; default port 7070). ``journal_dir`` enables
-    durable ingestion (ack-from-journal, background drain)."""
+    durable ingestion (ack-from-journal, background drain);
+    ``admission``/``rate_limit_qps`` enable 429 overload shedding on the
+    write endpoints (journal-fill pressure + per-access-key buckets)."""
     logging.basicConfig(level=logging.INFO)
     ingestor = None
     if journal_dir:
@@ -568,6 +620,19 @@ def run_event_server(ip: str = "0.0.0.0", port: int = 7070,
             max_bytes=int(journal_max_mb) * 1024 * 1024)
         log.info("Durable ingestion: journal at %s (fsync=%s, cap=%dMB)",
                  journal_dir, journal_fsync, journal_max_mb)
+    controller = None
+    if admission or rate_limit_qps > 0:
+        controller = AdmissionController(
+            "ingest",
+            journal_fill=ingestor.fill_fraction if ingestor else None,
+            backlog=(lambda: ingestor.journal.lag) if ingestor else None,
+            drain_per_s=ingestor.drain_rate_per_s if ingestor else None,
+            rate_limit_qps=rate_limit_qps,
+            rate_limit_burst=rate_limit_burst)
+        log.info("Admission control: journal-pressure shedding%s",
+                 f" + {rate_limit_qps:g} qps/key rate limit"
+                 if rate_limit_qps > 0 else "")
     log.info("Event server starting on %s:%d", ip, port)
-    web.run_app(create_event_app(stats=stats, ingestor=ingestor),
+    web.run_app(create_event_app(stats=stats, ingestor=ingestor,
+                                 admission=controller),
                 host=ip, port=port, print=None)

@@ -51,6 +51,7 @@ from ..obs.trace import current_request_id, trace_event
 from ..storage import Storage, event_from_api_dict, event_to_api_dict
 from ..storage.journal import EventJournal, JournalFull
 from ..obs.breaker import breaker_set as _breaker_set
+from ..workflow.admission import backpressure_retry_after_s
 from ..workflow.faults import FAULTS
 
 log = logging.getLogger("predictionio_tpu.eventserver")
@@ -110,6 +111,10 @@ class DurableIngestor:
         self.drained_batches = 0
         self.drain_failures = 0
         self.breaker_opens = 0
+        # EWMA of successful drain-batch wall time — sizes the dynamic
+        # Retry-After on journal-full 503s (lag / drain rate); None
+        # until the first batch lands
+        self._ewma_drain_s: float | None = None
 
     # -- ingest-side API ---------------------------------------------------
     def encode(self, event, app_id: int, channel_id: int | None) -> bytes:
@@ -226,6 +231,8 @@ class DurableIngestor:
         self._on_push_success()
         dt = time.perf_counter() - t0
         _M_DRAIN_BATCH.record(dt)
+        self._ewma_drain_s = (dt if self._ewma_drain_s is None
+                              else 0.7 * self._ewma_drain_s + 0.3 * dt)
         _M_JOURNAL_LAG.set(self.journal.lag)
         # the drainer's half of the event-path join: each journaled trace
         # id reappears here, after the backend upsert committed
@@ -316,6 +323,28 @@ class DurableIngestor:
         await asyncio.to_thread(self.journal.close)
 
     # -- surfaces ----------------------------------------------------------
+    def fill_fraction(self) -> float:
+        """Journal fullness in [0, 1] — the admission controller's
+        ``journal`` signal (sheds ingest shortly BEFORE the hard
+        journal-full 503)."""
+        j = self.journal.stats()
+        return j["sizeBytes"] / max(1, j["maxBytes"])
+
+    def drain_rate_per_s(self) -> float | None:
+        """Records/sec the drainer is clearing, or None before the first
+        successful batch (a broken-breaker drainer keeps its last
+        healthy estimate — the backlog math stays meaningful)."""
+        if self._ewma_drain_s is None or self._ewma_drain_s <= 0:
+            return None
+        return self.drain_batch / self._ewma_drain_s
+
+    def retry_after_s(self) -> float:
+        """Dynamic journal-full Retry-After: lag / drain rate (jittered,
+        capped) via the shared overload-control helper — the same pacing
+        the admission 429s use, instead of the old fixed constant."""
+        return backpressure_retry_after_s(
+            self.journal.lag, self.drain_rate_per_s())
+
     @property
     def degraded(self) -> bool:
         """The backend push path is failing (breaker not closed). Acks

@@ -242,6 +242,15 @@ class Histogram:
         with self._lock:
             return self._quantile_locked(q)
 
+    def bucket_counts(self) -> tuple[tuple[int, ...], int, float]:
+        """Cumulative-free raw bucket counts ``(counts, count, sum)``
+        (``counts[-1]`` is the overflow bucket). Samplers that need a
+        *windowed* quantile — e.g. the admission controller's recent
+        queue-wait p99 — diff two of these and interpolate over the
+        delta instead of the lifetime distribution."""
+        with self._lock:
+            return tuple(self._counts), self._count, self._sum
+
     def snapshot(self) -> dict:
         with self._lock:
             return {

@@ -452,6 +452,12 @@ def cmd_deploy(args) -> int:
         deadline_ms=args.deadline_ms,
         dispatch_timeout_s=args.dispatch_timeout_s,
         degraded_cooldown_s=args.degraded_cooldown_s,
+        admission=args.admission,
+        admission_queue_high=args.admission_queue_high,
+        admission_wait_budget_ms=args.admission_wait_budget_ms,
+        rate_limit_qps=args.rate_limit_qps,
+        rate_limit_burst=args.rate_limit_burst,
+        brownout_topk=args.brownout_topk,
         engine_dir=engine_dir,
         retriever_mesh=_retriever_mesh(args.retriever_mesh),
     )
@@ -587,7 +593,10 @@ def cmd_eventserver(args) -> int:
     run_event_server(ip=args.ip, port=args.port, stats=args.stats,
                      journal_dir=args.journal_dir,
                      journal_fsync=args.journal_fsync,
-                     journal_max_mb=args.journal_max_mb)
+                     journal_max_mb=args.journal_max_mb,
+                     admission=args.admission,
+                     rate_limit_qps=args.rate_limit_qps,
+                     rate_limit_burst=args.rate_limit_burst)
     return 0
 
 
@@ -854,6 +863,27 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--degraded-cooldown-s", type=float, default=15.0,
                     help="seconds between half-open probe batches while "
                          "the server is degraded")
+    sp.add_argument("--admission", action="store_true",
+                    help="adaptive admission control: shed queries with "
+                         "429 + Retry-After when queue depth, queue-wait "
+                         "p99 or deadline-expiry rate say the server is "
+                         "overloaded; enables brownout degradation")
+    sp.add_argument("--admission-queue-high", type=int, default=64,
+                    help="microbatch queue depth treated as full "
+                         "overload pressure (admission signal)")
+    sp.add_argument("--admission-wait-budget-ms", type=float, default=0.0,
+                    help="queue-wait p99 treated as full overload "
+                         "pressure (0 = half the --deadline-ms)")
+    sp.add_argument("--rate-limit-qps", type=float, default=0.0,
+                    help="per-client token-bucket rate limit (keyed on "
+                         "access key; 0 disables; over-limit answers "
+                         "429 + Retry-After)")
+    sp.add_argument("--rate-limit-burst", type=float, default=0.0,
+                    help="token-bucket burst headroom "
+                         "(0 = 2x --rate-limit-qps)")
+    sp.add_argument("--brownout-topk", type=int, default=10,
+                    help="top-k clamp applied to queries while the "
+                         "server is in brownout")
 
     sp = sub.add_parser("batchpredict")
     _add_engine_args(sp)
@@ -900,6 +930,16 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--journal-max-mb", type=int, default=256,
                     help="journal capacity; past it ingestion answers "
                          "503 + Retry-After (backpressure, default 256)")
+    sp.add_argument("--admission", action="store_true",
+                    help="adaptive admission control: shed ingestion "
+                         "with 429 + Retry-After when journal fill/lag "
+                         "says the drainer is falling behind")
+    sp.add_argument("--rate-limit-qps", type=float, default=0.0,
+                    help="per-access-key token-bucket rate limit "
+                         "(0 disables; over-limit answers 429)")
+    sp.add_argument("--rate-limit-burst", type=float, default=0.0,
+                    help="token-bucket burst headroom "
+                         "(0 = 2x --rate-limit-qps)")
 
     sp = sub.add_parser("adminserver")
     sp.add_argument("--ip", default="127.0.0.1")

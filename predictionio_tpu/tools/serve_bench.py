@@ -22,6 +22,7 @@ import time
 import numpy as np
 
 from ..obs.metrics import Histogram
+from ..workflow.faults import FAULTS
 
 __all__ = ["sweep", "format_table", "main", "DEFAULT_WAYS", "DEFAULT_BATCH"]
 
@@ -71,6 +72,10 @@ def sweep(ways=DEFAULT_WAYS, *, n_items: int = 65_536, rank: int = 64,
                          "single packed host pull)", buckets=_BENCH_BUCKETS_S)
         for _ in range(iters):
             t0 = time.perf_counter()
+            # chaos site: arm `slow` to model a degraded device under
+            # generated load — the delay lands inside the timed window,
+            # so it shows up in the emitted latency percentiles
+            FAULTS.fire("loadgen.slow_device")
             vals, _ = ret.topk(q, k)
             np.asarray(vals)  # host fence: time includes the one pull
             hist.record(time.perf_counter() - t0)

@@ -62,6 +62,7 @@ from ..obs.http import handle_metrics
 from ..obs.metrics import METRICS
 from ..obs.trace import TRACE_HEADER, ensure_request_id, trace_event
 from ..storage import EngineInstance, Storage
+from .admission import AdmissionController
 from .faults import FAULTS
 from .feedback import FeedbackPublisher
 from .microbatch import DeadlineExceeded, DispatchTimeout, ServerBusy
@@ -81,11 +82,20 @@ _M_SERVE = METRICS.histogram(
     "end-to-end POST /queries.json latency as the client saw it")
 _M_QUERIES = METRICS.counter(
     "pio_queries_total",
-    "queries by outcome (ok/bad_request/busy/deadline/watchdog/draining)",
+    "queries by outcome (ok/bad_request/busy/deadline/watchdog/draining/"
+    "shed)",
     labelnames=("status",))
 _M_DEGRADED = METRICS.gauge(
     "pio_degraded_mode",
     "1 while the engine server serves on the degraded fallback path")
+# ISSUE 6: ONE unified server mode — brownout (overload pressure) and
+# degraded (watchdog trips) share this gauge so the two mechanisms can
+# never disagree about what state the server is in
+_MODE_LEVELS = {"normal": 0, "brownout": 1, "degraded": 2}
+_M_MODE = METRICS.gauge(
+    "pio_server_mode",
+    "unified engine-server mode: 0 normal, 1 brownout (overload "
+    "degradation), 2 degraded (watchdog fallback)")
 # same family microbatch.py counts on its paths — the fallback path's
 # expiries must not vanish from the counter just because batching is off
 _M_DEADLINE = METRICS.counter(
@@ -199,6 +209,12 @@ class EngineServer:
         retriever_mesh=None,
         retriever_axis: str = "model",
         fallback: bool = True,
+        admission: bool = False,
+        admission_queue_high: int = 64,
+        admission_wait_budget_ms: float = 0.0,
+        rate_limit_qps: float = 0.0,
+        rate_limit_burst: float = 0.0,
+        brownout_topk: int = 10,
     ):
         self.engine = engine
         self.ctx = ctx or Context(mode="Serving")
@@ -243,9 +259,15 @@ class EngineServer:
                                    if dispatch_timeout_s and
                                    dispatch_timeout_s > 0 else None)
         self.degraded_cooldown_s = max(0.1, degraded_cooldown_s)
-        self.degraded = False
+        # unified server mode (ISSUE 6): normal < brownout < degraded.
+        # Brownout is entered/left by admission pressure; degraded only
+        # by watchdog trips / probe success. ONE field means the two
+        # mechanisms cannot disagree about what state the server is in.
+        self._mode = "normal"
         self.degraded_since: str | None = None
         self._probe_at: float | None = None  # next half-open probe instant
+        self.brownout_topk = max(0, brownout_topk)
+        self.brownout_since: str | None = None
         self._inflight_configured = max(1, batch_inflight)
         self._draining = False
         self._drained = False
@@ -266,8 +288,77 @@ class EngineServer:
                 dispatch_timeout_s=self.dispatch_timeout_s,
                 on_watchdog=self._on_watchdog_trip,
             )
+        # adaptive admission (ISSUE 6): shed 429 + Retry-After at ingress
+        # off live batcher/registry signals, before work can blow its
+        # deadline downstream. Off unless --admission or a rate limit is
+        # set — shedding policy is an operator opt-in.
+        self.admission: AdmissionController | None = None
+        if admission or rate_limit_qps > 0:
+            b = self.batcher
+            wait_budget_s = (
+                admission_wait_budget_ms / 1e3 if admission_wait_budget_ms > 0
+                else (self.deadline_ms / 2e3 if self.deadline_ms > 0 else 0.0))
+            self.admission = AdmissionController(
+                "serve",
+                queue_depth=(lambda: len(b._pending)) if b else None,
+                queue_high=admission_queue_high,
+                wait_hist_name="pio_microbatch_queue_wait_seconds",
+                wait_budget_s=wait_budget_s,
+                inflight=(lambda: b._live / b.max_inflight) if b else None,
+                expiry_counter_name="pio_deadline_expired_total",
+                backlog=(lambda: len(b._pending)) if b else None,
+                drain_per_s=b.drain_rate_per_s if b else None,
+                rate_limit_qps=rate_limit_qps,
+                rate_limit_burst=rate_limit_burst,
+            )
 
-    # -- resilience: degraded mode, deadlines, drain -----------------------
+    # -- resilience: unified mode (normal/brownout/degraded), deadlines ----
+    @property
+    def mode(self) -> str:
+        return self._mode
+
+    @property
+    def degraded(self) -> bool:
+        return self._mode == "degraded"
+
+    def _set_mode(self, mode: str) -> None:
+        if mode == self._mode:
+            return
+        prev, self._mode = self._mode, mode
+        _M_MODE.set(_MODE_LEVELS[mode])
+        _M_DEGRADED.set(1 if mode == "degraded" else 0)
+        now_iso = datetime.now(timezone.utc).isoformat()
+        self.degraded_since = now_iso if mode == "degraded" else None
+        self.brownout_since = now_iso if mode == "brownout" else None
+        log.warning("server mode: %s -> %s", prev, mode)
+
+    def _update_brownout(self) -> None:
+        """Enter/leave brownout from admission pressure. Never touches
+        degraded — the watchdog outranks overload, and only a successful
+        half-open probe may leave degraded."""
+        if self.admission is None or self._mode == "degraded":
+            return
+        if self._mode == "normal" and self.admission.overloaded:
+            self._set_mode("brownout")
+        elif self._mode == "brownout" and self.admission.recovered:
+            self._set_mode("normal")
+
+    def brownout_degrade(self, query_json: dict) -> dict:
+        """Brownout/degraded quality reduction: clamp top-k-style count
+        fields so each admitted query costs less while the server digs
+        out. Returns the query unchanged in normal mode."""
+        if self._mode == "normal" or self.brownout_topk <= 0:
+            return query_json
+        out = None
+        for k in ("num", "k", "topK", "top_k", "limit"):
+            v = query_json.get(k)
+            if isinstance(v, int) and not isinstance(v, bool) \
+                    and v > self.brownout_topk:
+                if out is None:
+                    out = dict(query_json)
+                out[k] = self.brownout_topk
+        return out if out is not None else query_json
+
     def _on_watchdog_trip(self) -> None:
         """Runs on the event loop after each stuck-dispatch watchdog trip
         (microbatch.MicroBatcher.on_watchdog): enter degraded mode —
@@ -276,9 +367,9 @@ class EngineServer:
         piling more concurrency onto it digs the hole deeper). A
         half-open probe per cooldown window decides when to resume."""
         if not self.degraded:
-            self.degraded = True
-            self.degraded_since = datetime.now(timezone.utc).isoformat()
-            _M_DEGRADED.set(1)
+            # degraded outranks brownout: a watchdog trip preempts any
+            # overload state (the _set_mode transition keeps it unified)
+            self._set_mode("degraded")
             if self.batcher is not None:
                 self.batcher.set_max_inflight(
                     max(1, self.batcher.max_inflight // 2))
@@ -292,12 +383,16 @@ class EngineServer:
     def _exit_degraded(self) -> None:
         log.info("leaving degraded mode (probe batch succeeded); "
                  "max_inflight restored to %d", self._inflight_configured)
-        self.degraded = False
-        self.degraded_since = None
         self._probe_at = None
-        _M_DEGRADED.set(0)
         if self.batcher is not None:
             self.batcher.set_max_inflight(self._inflight_configured)
+        # drop to brownout (not straight to normal) when overload
+        # pressure is still high — the probe proved the DEVICE healthy,
+        # not the queue empty
+        if self.admission is not None and self.admission.overloaded:
+            self._set_mode("brownout")
+        else:
+            self._set_mode("normal")
 
     @property
     def draining(self) -> bool:
@@ -341,6 +436,11 @@ class EngineServer:
                 # here means the batched path is healthy again
                 self._exit_degraded()
                 return result
+            return await self._fallback_query(query_json, deadline)
+        if self._mode == "brownout":
+            # brownout serves on the per-query fallback path too: the
+            # batcher's queue is the thing under pressure, and the
+            # fallback path is bounded by deadline + watchdog
             return await self._fallback_query(query_json, deadline)
         return await self.batcher.submit(query_json, deadline=deadline)
 
@@ -404,11 +504,19 @@ class EngineServer:
         b = self.batcher
         return {
             "status": ("draining" if self._draining
-                       else "degraded" if self.degraded else "ok"),
+                       else self._mode if self._mode != "normal" else "ok"),
+            "mode": self._mode,
             "live": True,
             "ready": not self._draining,
             "engineInstanceId": inst.id,
             "startTime": self.start_time.isoformat(),
+            "admission": (self.admission.stats()
+                          if self.admission is not None else None),
+            "brownout": {
+                "active": self._mode == "brownout",
+                "since": self.brownout_since,
+                "topk": self.brownout_topk,
+            },
             "degraded": {
                 "active": self.degraded,
                 "since": self.degraded_since,
@@ -618,9 +726,15 @@ class EngineServer:
             },
             "batching": self.batcher.stats() if self.batcher else None,
             "execCache": EXEC_CACHE.stats(),
+            "admission": (self.admission.stats()
+                          if self.admission is not None else None),
             "resilience": {
+                "mode": self._mode,
                 "degraded": self.degraded,
                 "degradedSince": self.degraded_since,
+                "brownoutSince": self.brownout_since,
+                "codelDropped": (self.batcher.codel_dropped
+                                 if self.batcher else 0),
                 "watchdogTrips": (self.batcher.watchdog_trips
                                   if self.batcher else 0),
                 "deadlineExpired": (self.batcher.deadline_expired
@@ -648,18 +762,36 @@ async def handle_query(request: web.Request) -> web.Response:
     rid = ensure_request_id(request.headers.get(TRACE_HEADER))
     t0 = time.perf_counter()
 
-    def _done(status_label: str, body: dict, status: int = 200) -> web.Response:
+    def _done(status_label: str, body: dict, status: int = 200,
+              retry_after_s: float | None = None) -> web.Response:
         _M_SERVE.record(time.perf_counter() - t0)
         _M_QUERIES.inc(status=status_label)
         trace_event("serve.ingress", status=status_label,
                     http=status, ms=round((time.perf_counter() - t0) * 1e3, 3))
-        return web.json_response(body, status=status,
-                                 headers={TRACE_HEADER: rid})
+        headers = {TRACE_HEADER: rid}
+        if retry_after_s is not None:
+            # decimal seconds: our own clients (FeedbackPublisher) parse
+            # floats, and sub-second pacing matters at serving rates
+            headers["Retry-After"] = f"{max(0.0, retry_after_s):.3f}"
+        return web.json_response(body, status=status, headers=headers)
 
     if server.draining:
         return _done("draining",
                      {"message": "Server is draining; not accepting queries."},
                      503)
+    if server.admission is not None:
+        # adaptive admission (ISSUE 6): shed at ingress with 429 +
+        # Retry-After before the request can pay the queue just to 504
+        client_key = (request.query.get("accessKey")
+                      or request.headers.get("X-PIO-Access-Key")
+                      or (request.remote or "unknown"))
+        decision = server.admission.decide("serve", key=client_key)
+        server._update_brownout()
+        if not decision.admitted:
+            return _done("shed",
+                         {"message": f"overloaded; retry later "
+                                     f"({decision.reason})"},
+                         429, retry_after_s=decision.retry_after_s)
     try:
         query_json = await request.json()
     except (json.JSONDecodeError, UnicodeDecodeError):
@@ -669,7 +801,8 @@ async def handle_query(request: web.Request) -> web.Response:
                      {"message": "Query must be a JSON object."}, 400)
     try:
         result = await server.dispatch_query(
-            query_json, deadline=server.request_deadline(request))
+            server.brownout_degrade(query_json),
+            deadline=server.request_deadline(request))
     except DeadlineExceeded as e:
         return _done("deadline", {"message": str(e)}, 504)
     except DispatchTimeout as e:
@@ -679,7 +812,14 @@ async def handle_query(request: web.Request) -> web.Response:
     except Exception as e:  # noqa: BLE001 — surface as 400 like the reference
         log.exception("query failed")
         return _done("error", {"message": str(e)}, 400)
-    if server.feedback is not None:
+    publish = server.feedback is not None
+    if publish and server.mode != "normal":
+        # brownout/degraded sheds feedback publication first — it is the
+        # cheapest work to lose and its class threshold agrees (0.7)
+        publish = False
+    if publish and server.admission is not None:
+        publish = server.admission.decide("feedback").admitted
+    if publish:
         pr_id = uuid.uuid4().hex
         result_with_pr = {**result, "prId": pr_id} if isinstance(result, dict) else result
         server.feedback.publish(query_json, result, pr_id, request_id=rid)

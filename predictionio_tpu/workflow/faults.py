@@ -39,6 +39,14 @@ Sites instrumented in this repo:
   model blob is inserted (sync site; models a preemption between
   training and persistence — the last moment a run can die with a full
   model's work to lose)
+- ``admission.decide``      — head of ``AdmissionController.decide``
+  (sync site; an ``error`` proves the fail-OPEN path — overload
+  control must never become the outage, so a broken controller admits
+  and counts ``decision="error_open"``)
+- ``loadgen.slow_device``   — inside the ``pio bench serve`` load
+  generator's timed loop (``tools/serve_bench.sweep``), before each
+  device top-k call; arm ``slow`` to model a degraded device under
+  generated load and watch the latency histogram move
 
 A fault is armed per site with a kind:
 
@@ -81,6 +89,8 @@ SITES: tuple[str, ...] = (
     "eventserver.drain",
     "train.step",
     "train.persist",
+    "admission.decide",
+    "loadgen.slow_device",
 )
 
 #: chaos runs must always be measurable: one counter series per site,

@@ -14,6 +14,10 @@ lost. This module replaces that with a lifecycle-owned publisher:
   exponential backoff (oldest entries drop when the queue is full — the
   feedback loop is best-effort telemetry, it must never become an
   unbounded memory leak because the event server is down);
+- a 429/503 answer with ``Retry-After`` (the event server's admission
+  layer under overload) schedules the replay at the SERVER-provided
+  horizon instead of the local exponential guess, and does not advance
+  the circuit breaker — a shedding server is alive, not dead;
 - a circuit breaker (closed → open → half-open) stops hammering a dead
   event server: past ``breaker_threshold`` consecutive failures new
   publishes drop fast; after ``breaker_reset_s`` ONE probe is let
@@ -40,6 +44,22 @@ from .faults import FAULTS
 log = logging.getLogger("predictionio_tpu.server")
 
 __all__ = ["FeedbackPublisher"]
+
+
+class _Backpressure(RuntimeError):
+    """The event server said 429/503 — back off for as long as IT asked.
+
+    Carries the parsed ``Retry-After`` (seconds, possibly fractional —
+    the admission layer emits decimal seconds) so the retry queue can
+    schedule the replay at the server-provided horizon instead of the
+    local exponential guess. A publisher that ignores Retry-After and
+    retries on its own clock is exactly the feedback storm the event
+    server's admission controller exists to shed."""
+
+    def __init__(self, status: int, retry_after_s: float | None):
+        super().__init__(f"event server answered {status} (backpressure)")
+        self.status = status
+        self.retry_after_s = retry_after_s
 
 # ISSUE 5: breaker state/transition gauges live in obs/breaker.py
 # (shared with the ingest drainer); these two are feedback-specific
@@ -197,10 +217,26 @@ class FeedbackPublisher:
                 params={"accessKey": self.access_key},
                 json=event,
             ) as resp:
+                if resp.status in (429, 503):
+                    ra = resp.headers.get("Retry-After")
+                    try:
+                        ra_s = float(ra) if ra is not None else None
+                    except ValueError:
+                        ra_s = None
+                    raise _Backpressure(resp.status, ra_s)
                 if resp.status >= 500:
                     raise RuntimeError(f"event server answered {resp.status}")
         except asyncio.CancelledError:
             raise
+        except _Backpressure as e:
+            # A shedding server is ALIVE — don't advance the breaker's
+            # consecutive-failure count; replay at the horizon the server
+            # itself asked for (its Retry-After is lag-proportional).
+            self.failed += 1
+            _M_FEEDBACK.inc(outcome="failed")
+            self._enqueue_retry(event, attempt + 1,
+                                retry_after_s=e.retry_after_s)
+            return
         except Exception as e:  # noqa: BLE001 — feedback is best-effort
             self._on_failure(e)
             self._enqueue_retry(event, attempt + 1)
@@ -208,7 +244,8 @@ class FeedbackPublisher:
         self._on_success()
 
     # -- retry queue -------------------------------------------------------
-    def _enqueue_retry(self, event: dict, attempt: int) -> None:
+    def _enqueue_retry(self, event: dict, attempt: int,
+                       retry_after_s: float | None = None) -> None:
         if attempt > self.retry_max:
             self.dropped += 1
             _M_FEEDBACK.inc(outcome="dropped")
@@ -217,11 +254,17 @@ class FeedbackPublisher:
             self._retry.popleft()  # oldest out: the queue is a buffer,
             self.dropped += 1      # not an archive
             _M_FEEDBACK.inc(outcome="dropped")
-        backoff = min(self.backoff_cap_s,
-                      self.backoff_base_s * (2 ** (attempt - 1)))
-        # full jitter: desynchronizes a thundering herd of retries when
-        # the event server comes back
-        delay = backoff * (0.5 + random.random() / 2)
+        if retry_after_s is not None:
+            # server-provided horizon (429/503 Retry-After) wins over the
+            # local exponential guess; small positive jitter so a herd of
+            # publishers doesn't replay on the same tick
+            delay = max(0.0, retry_after_s) * (1.0 + 0.1 * random.random())
+        else:
+            backoff = min(self.backoff_cap_s,
+                          self.backoff_base_s * (2 ** (attempt - 1)))
+            # full jitter: desynchronizes a thundering herd of retries
+            # when the event server comes back
+            delay = backoff * (0.5 + random.random() / 2)
         self._retry.append((event, attempt, time.monotonic() + delay))
         _M_RETRY_DEPTH.set(len(self._retry))
         self._ensure_worker()

@@ -72,6 +72,10 @@ _M_DEVICE = METRICS.histogram(
 _M_DEADLINE = METRICS.counter(
     "pio_deadline_expired_total",
     "queries failed 504 because their end-to-end deadline passed")
+_M_CODEL = METRICS.counter(
+    "pio_codel_dropped_total",
+    "queries dropped at enqueue because their estimated queue sojourn "
+    "already exceeded their deadline (CoDel-style early shed)")
 _M_WATCHDOG = METRICS.counter(
     "pio_watchdog_reclaims_total",
     "stuck-dispatch watchdog trips (pipeline slot reclaimed, thread "
@@ -149,6 +153,11 @@ class MicroBatcher:
         self.peak_inflight = 0
         self.watchdog_trips = 0
         self.deadline_expired = 0
+        self.codel_dropped = 0
+        # EWMA of successful dispatch wall time — the CoDel sojourn
+        # estimate and the admission controller's drain-rate both key
+        # off it; None until the first batch completes
+        self._ewma_dispatch_s: float | None = None
 
     def _ensure_started(self) -> None:
         if self._task is None or self._task.done():
@@ -179,6 +188,23 @@ class MicroBatcher:
         if len(self._pending) >= self.max_pending:
             raise ServerBusy(
                 f"micro-batch queue full ({self.max_pending} pending)")
+        if deadline is not None:
+            # CoDel-style sojourn check: if the queue ahead of this query
+            # cannot drain before its deadline, fail it NOW instead of
+            # letting it rot in the queue to be swept at batch formation.
+            # Engages only once the queue is at least one full batch deep
+            # AND dispatch history exists — a cold or shallow queue never
+            # pre-drops (the sweep remains the authority there).
+            est = self._estimate_sojourn_s()
+            if est > 0 and time.monotonic() + est >= deadline:
+                self.codel_dropped += 1
+                _M_CODEL.inc()
+                trace_event("serve.codel_dropped", where="submit",
+                            est_sojourn_ms=round(est * 1e3, 3),
+                            queued=len(self._pending))
+                raise DeadlineExceeded(
+                    f"queue sojourn estimate {est * 1e3:.1f}ms exceeds "
+                    f"remaining deadline; dropped at enqueue")
         self._ensure_started()
         if self.adaptive:
             self._note_arrival(time.monotonic())
@@ -188,6 +214,29 @@ class MicroBatcher:
         assert self._wake is not None
         self._wake.set()
         return await fut
+
+    def _estimate_sojourn_s(self) -> float:
+        """Expected queue wait for a query enqueued now: the number of
+        pipeline waves the queued-ahead batches need, times the EWMA
+        dispatch time. Deliberately conservative — returns 0.0 (never
+        drop) until the queue is >= one full batch deep and at least one
+        dispatch has completed."""
+        if self._ewma_dispatch_s is None or len(self._pending) < self.max_batch:
+            return 0.0
+        batches_ahead = len(self._pending) // self.max_batch
+        waves = (batches_ahead + self.max_inflight - 1) // self.max_inflight
+        # + partial wave when every pipeline slot is already busy
+        if self._live >= self.max_inflight:
+            waves += 1
+        return waves * self._ewma_dispatch_s
+
+    def drain_rate_per_s(self) -> float | None:
+        """Throughput estimate (queries/sec) at the current pipeline
+        shape, or None before the first dispatch completes. The
+        admission controller sizes Retry-After from this."""
+        if self._ewma_dispatch_s is None or self._ewma_dispatch_s <= 0:
+            return None
+        return self.max_batch * self.max_inflight / self._ewma_dispatch_s
 
     def _note_arrival(self, now: float) -> None:
         if self._last_arrival is not None:
@@ -419,6 +468,9 @@ class MicroBatcher:
             self.max_seen_batch = max(self.max_seen_batch, len(batch))
             dispatch_s = time.monotonic() - t_start
             _M_DISPATCH.record(dispatch_s)
+            self._ewma_dispatch_s = (
+                dispatch_s if self._ewma_dispatch_s is None
+                else 0.7 * self._ewma_dispatch_s + 0.3 * dispatch_s)
             trace_event("serve.dispatch", trace=None, traces=traces,
                         batch=len(batch), ms=round(dispatch_s * 1e3, 3))
             for (_, fut, *_rest), (tag, payload) in zip(batch, outcomes):
@@ -451,4 +503,7 @@ class MicroBatcher:
             "watchdogTrips": self.watchdog_trips,
             "zombieDispatches": self._zombies,
             "deadlineExpired": self.deadline_expired,
+            "codelDropped": self.codel_dropped,
+            "ewmaDispatchMs": (self._ewma_dispatch_s * 1e3
+                               if self._ewma_dispatch_s is not None else None),
         }

@@ -52,6 +52,12 @@ def pytest_configure(config):
         "reaping and blob-integrity fallback — test_train_supervision.py); "
         "shares the chaos guard's SIGALRM timeout and fault cleanup; "
         "select with -m train_chaos")
+    config.addinivalue_line(
+        "markers",
+        "overload: admission-control / backpressure / brownout tests "
+        "(workflow/admission.py, the engine server's overload surfaces "
+        "and the event server's 429 path — test_overload.py); chaos-"
+        "guarded when also marked chaos; select with -m overload")
 
 
 #: Hard per-test budget for chaos tests. Injected hangs are capped at

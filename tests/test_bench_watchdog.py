@@ -213,9 +213,12 @@ def test_budget_zero_skips_floor_too_but_artifact_survives():
     """Fully exhausted budget on the cpu-fallback path: even the floor is
     skipped (labeled), and the artifact still carries the headline
     without vs_baseline — better an artifact without a floor than a run
-    killed mid-floor. Subprocess: the fallback reconfigures jax and the
-    probe path sleeps, neither of which an in-process test can stub
-    safely."""
+    killed mid-floor. Since ISSUE 6 the fallback also FAILS LOUD: the
+    rows are stamped `"invalid": true` + platform, and the process exits
+    3 so a driver can never mistake a cpu-fallback headline for a real
+    one (the BENCH_r04/r05 trap). Subprocess: the fallback reconfigures
+    jax and the probe path sleeps, neither of which an in-process test
+    can stub safely."""
     code = r"""
 import json, sys, time as _t
 sys.path.insert(0, %r)
@@ -240,11 +243,13 @@ bench.main()
 """ % str(REPO)
     out = subprocess.run([sys.executable, "-c", code], capture_output=True,
                          text=True, timeout=300)
-    assert out.returncode == 0, out.stderr[-2000:]
+    assert out.returncode == 3, (out.returncode, out.stderr[-2000:])
     j = json.loads(out.stdout.strip().splitlines()[-1])
     assert j["vs_baseline"] == 0.0
     assert "cpu floor" in j["config"]["budget_skipped"]
     assert j["config"]["platform"] == "cpu-fallback"
+    assert j["platform"] == "cpu-fallback"  # top level: no config digging
+    assert j["invalid"] is True
 
 
 def test_main_wedge_skips_accelerator_phases_only(monkeypatch, capsys):

@@ -104,6 +104,30 @@ def test_pio_eventserver_help_documents_journal_flags(tmp_path):
         assert policy in out.stdout
 
 
+def test_pio_eventserver_help_documents_admission_flags(tmp_path):
+    """The overload-control knobs (ISSUE 6) are operator surface too:
+    ingestion admission + per-key rate limiting."""
+    env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
+    out = subprocess.run([str(REPO / "bin" / "pio"), "eventserver", "--help"],
+                         capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    for flag in ("--admission", "--rate-limit-qps", "--rate-limit-burst"):
+        assert flag in out.stdout, f"{flag} missing from eventserver --help"
+
+
+def test_pio_deploy_help_documents_overload_flags(tmp_path):
+    """`pio deploy --help` must advertise the admission / rate-limit /
+    brownout knobs the Overload-control runbook documents."""
+    env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
+    out = subprocess.run([str(REPO / "bin" / "pio"), "deploy", "--help"],
+                         capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    for flag in ("--admission", "--admission-queue-high",
+                 "--admission-wait-budget-ms", "--rate-limit-qps",
+                 "--rate-limit-burst", "--brownout-topk"):
+        assert flag in out.stdout, f"{flag} missing from deploy --help"
+
+
 def test_pio_train_help_documents_supervision_flags(tmp_path):
     """The preemption-tolerance knobs are operator surface: `pio train
     --help` must advertise the supervised-retry / budget flags the
