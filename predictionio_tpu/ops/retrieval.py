@@ -33,7 +33,7 @@ from ..workflow.faults import FAULTS
 
 __all__ = ["topk_scores", "DeviceRetriever", "ShardedDeviceRetriever",
            "RetrievalServingMixin", "row_normalize", "ExecutableCache",
-           "EXEC_CACHE"]
+           "EXEC_CACHE", "choose_shard_count"]
 
 # ISSUE 5: the executable cache's behavior under shape churn, scrapeable
 # (stats() keeps its dict shape for /stats.json; same increments)
@@ -725,6 +725,38 @@ class ShardedDeviceRetriever:
         return warmed
 
 
+#: choose_shard_count's cost model, in scanned-item units per query:
+#: sharding w ways scans N/w rows per device but pays the cross-shard
+#: candidate merge — a near-fixed collective/launch cost plus a small
+#: per-way term. Calibrated against BENCH_r05's measured inversion
+#: (8-way 2606 qps < 1-way 3427 qps at a 64k catalog: the merge costs
+#: more than 64k/8-per-way saves, so the crossover sits near ~1M rows).
+MERGE_COST_FIXED = 192_000
+MERGE_COST_PER_WAY = 16_000
+
+
+def choose_shard_count(n_total: int, ndev: int, *,
+                       merge_fixed: int = MERGE_COST_FIXED,
+                       merge_per_way: int = MERGE_COST_PER_WAY) -> int:
+    """Shard count for a catalog of ``n_total`` rows on ``ndev`` devices:
+    argmin over power-of-two widths of ``N/w + (w > 1) * (merge_fixed +
+    merge_per_way * w)``. Closes the BENCH_r05 sharded-serving inversion
+    by construction — a width is only picked when its per-shard scan
+    saving exceeds the merge it adds, so 8-way can never be selected
+    where the model says 1-way is faster. Deploy (``--retriever-mesh
+    auto``) and ``pio bench serve --ways auto`` both route through here
+    at executable-build time."""
+    ndev = max(1, int(ndev))
+    best_w, best_cost = 1, float(max(0, n_total))
+    w = 2
+    while w <= ndev:
+        cost = n_total / w + merge_fixed + merge_per_way * w
+        if cost < best_cost:
+            best_w, best_cost = w, cost
+        w *= 2
+    return best_w
+
+
 class RetrievalServingMixin:
     """Serving-side device retrieval for models whose predict step is
     "score a catalog matrix against one query row, keep top-k" (ALS
@@ -811,6 +843,20 @@ class RetrievalServingMixin:
         self._retriever = DeviceRetriever(
             getattr(self, self._retrieval_attr), interpret=interpret
         )
+
+    def attach_ann_retriever(self, interpret=None, **params) -> None:
+        """Serve top-N through the IVF approximate-MIPS index
+        (ops/ann.py AnnRetriever) — same serving surface, sub-linear
+        scan. ``params`` is the engine-params ``retrieval`` block minus
+        ``mode`` (nprobe / quantize / n_cells / min_items /
+        kmeans_iters / kmeans_sample / max_cell_factor / seed). Small
+        catalogs and failed builds fall back to exact inside the
+        retriever; /reload swaps it like any retriever."""
+        from .ann import AnnRetriever
+
+        self._retriever = AnnRetriever(
+            getattr(self, self._retrieval_attr), interpret=interpret,
+            **params)
 
     def attach_sharded_retriever(self, mesh, *, axis: str = "model") -> None:
         """Serve top-N from a catalog SHARDED over ``mesh``'s ``axis`` —

@@ -431,6 +431,18 @@ def _resolve_engine_instance(args):
     return engine_dir, engine, inst
 
 
+def _retrieval_params(engine_dir: Path, args) -> dict | None:
+    """The engine-params ``retrieval: {mode: exact|ann, nprobe,
+    quantize, ...}`` block from engine.json (ISSUE 7), with
+    ``--retrieval-mode`` overriding the mode from the command line.
+    None when neither says anything (exact serving, zero new cost)."""
+    block = _load_variant(engine_dir, args.engine_json).get("retrieval")
+    block = dict(block) if isinstance(block, dict) else {}
+    if getattr(args, "retrieval_mode", None):
+        block["mode"] = args.retrieval_mode
+    return block or None
+
+
 def cmd_deploy(args) -> int:
     _enable_compile_cache()
     from ..workflow.create_server import run_engine_server
@@ -460,6 +472,7 @@ def cmd_deploy(args) -> int:
         brownout_topk=args.brownout_topk,
         engine_dir=engine_dir,
         retriever_mesh=_retriever_mesh(args.retriever_mesh),
+        retrieval=_retrieval_params(engine_dir, args),
     )
     return 0
 
@@ -527,10 +540,20 @@ def cmd_batchpredict(args) -> int:
     return 0 if n_err == 0 else 1
 
 
-def _retriever_mesh(n: int):
+def _retriever_mesh(n):
     """Mesh for catalog-sharded serving (--retriever-mesh N): the item
     catalog shards over an N-device "model" axis instead of living
-    replicated on one device (ops/retrieval.ShardedDeviceRetriever)."""
+    replicated on one device (ops/retrieval.ShardedDeviceRetriever).
+    ``auto`` defers the width to the catalog-size cost model
+    (ops/retrieval.choose_shard_count) at deploy time, when the catalog
+    length is known."""
+    if isinstance(n, str):
+        if n.strip().lower() == "auto":
+            return "auto"
+        try:
+            n = int(n)
+        except ValueError:
+            _die(f"--retriever-mesh must be an integer or 'auto', got {n!r}")
     if not n or n <= 1:
         return None
     from ..parallel.mesh import make_mesh
@@ -548,15 +571,32 @@ def cmd_bench(args) -> int:
     process cannot do for itself."""
     import subprocess
 
-    ways = [int(w) for w in args.ways.split(",") if w.strip()]
+    ways: list = []
+    for w in args.ways.split(","):
+        w = w.strip()
+        if not w:
+            continue
+        if w.lower() == "auto":
+            # the child resolves "auto" via choose_shard_count once it
+            # knows the device count; force the full 8-device mesh so
+            # the cost model has real widths to pick from
+            ways.append("auto")
+        else:
+            try:
+                ways.append(int(w))
+            except ValueError:
+                _die(f"--ways entries must be integers or 'auto', got {w!r}")
     if not ways:
         _die("--ways must name at least one mesh width, e.g. 1,8")
+    max_ways = max([w for w in ways if isinstance(w, int)] or [1])
+    if "auto" in ways:
+        max_ways = max(max_ways, 8)
     env = dict(os.environ)
     env.setdefault("JAX_PLATFORMS", "cpu")
     if env["JAX_PLATFORMS"] == "cpu":
         env["XLA_FLAGS"] = (
             env.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={max(ways)}"
+            + f" --xla_force_host_platform_device_count={max_ways}"
         ).strip()
     repo_root = str(Path(__file__).resolve().parents[2])
     env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
@@ -564,7 +604,8 @@ def cmd_bench(args) -> int:
            "--ways", ",".join(map(str, ways)),
            "--batch", str(args.batch), "--k", str(args.k),
            "--iters", str(args.iters), "--n-items", str(args.n_items),
-           "--rank", str(args.rank)]
+           "--rank", str(args.rank),
+           "--retrieval", args.retrieval]
     return subprocess.run(cmd, env=env).returncode
 
 
@@ -849,9 +890,17 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--batch-inflight", type=int, default=8,
                     help="max micro-batches dispatched concurrently "
                          "(pipelines the per-call dispatch round trip)")
-    sp.add_argument("--retriever-mesh", type=int, default=0,
+    sp.add_argument("--retriever-mesh", default="0",
                     help="shard the serving catalog over this many devices "
-                         "(model axis; 0/1 = single-device catalog)")
+                         "(model axis; 0/1 = single-device catalog; 'auto' "
+                         "picks 1/2/4/8-way from the catalog-size cost "
+                         "model at deploy time)")
+    sp.add_argument("--retrieval-mode", choices=["exact", "ann"],
+                    default=None,
+                    help="override the engine-params retrieval.mode: 'ann' "
+                         "serves from the quantized IVF index (exact "
+                         "fallback below its min-items floor), 'exact' "
+                         "forces brute-force scoring")
     sp.add_argument("--deadline-ms", type=float, default=0.0,
                     help="default end-to-end deadline per query in ms "
                          "(expired queries answer 504; 0 disables; the "
@@ -904,12 +953,17 @@ def build_parser() -> argparse.ArgumentParser:
                               "widths (fresh subprocess; CPU devices "
                               "forced to max(--ways))")
     x.add_argument("--ways", default="1,2,4,8",
-                   help="comma-separated mesh widths")
+                   help="comma-separated mesh widths; 'auto' adds the "
+                        "width the catalog-size cost model would pick")
     x.add_argument("--batch", type=int, default=128)
     x.add_argument("--k", type=int, default=10)
     x.add_argument("--iters", type=int, default=12)
     x.add_argument("--n-items", type=int, default=65_536)
     x.add_argument("--rank", type=int, default=64)
+    x.add_argument("--retrieval", choices=["exact", "ann"], default="exact",
+                   help="retrieval mode to bench: exact brute-force "
+                        "scoring or the quantized ANN index (reports "
+                        "recall@k against exact)")
 
     sp = sub.add_parser("undeploy")
     sp.add_argument("--ip", default="localhost")

@@ -127,7 +127,16 @@ class Deployed:
     replicated on one device — and the reload path passes them through,
     so /reload preserves the sharded configuration rather than silently
     de-sharding a catalog that was sharded because it exceeds one chip's
-    HBM.
+    HBM. ``retriever_mesh="auto"`` defers the width to the
+    ``ops/retrieval.choose_shard_count`` cost model per catalog (1-way
+    where the BENCH_r05 inversion says the merge costs more than the
+    sharding saves).
+
+    ``retrieval``: the engine-params ``retrieval: {mode: exact|ann,
+    nprobe, quantize, ...}`` block (ISSUE 7). ``mode: "ann"`` attaches
+    the IVF approximate-MIPS retriever (ops/ann.AnnRetriever) on ANY
+    backend — it is a plain XLA program — with automatic exact fallback
+    for small catalogs and failed index builds; reload preserves it.
     """
 
     instance: EngineInstance
@@ -135,31 +144,68 @@ class Deployed:
     retriever_mesh: object = None
     retriever_axis: str = "model"
     prewarm_batch: int = 0  # pre-compile executables for this batch ceiling
+    retrieval: dict | None = None
 
-    def __post_init__(self):
-        # On TPU backends, move catalog factors device-resident so queries
-        # run through the fused Pallas top-k kernel. Building the retriever
-        # on the NEW bundle before the swap is the double-buffered /reload:
-        # the old bundle keeps serving until this one is fully on-device.
+    def _resolved_mesh(self, model):
+        """``retriever_mesh`` for one model: pass-through, or the
+        cost-model width when configured "auto" (1 → no mesh at all)."""
+        if self.retriever_mesh != "auto":
+            return self.retriever_mesh
         import jax
 
-        if jax.default_backend() != "tpu" and self.retriever_mesh is None:
+        from ..ops.retrieval import choose_shard_count
+
+        catalog = getattr(model, getattr(model, "_retrieval_attr", ""), None)
+        n = 0 if catalog is None else len(catalog)
+        w = choose_shard_count(n, len(jax.devices()))
+        log.info("retriever_mesh=auto: cost model picked %d-way for a "
+                 "%d-row catalog", w, n)
+        if w <= 1:
+            return None
+        from ..parallel.mesh import make_mesh
+
+        return make_mesh((w,), (self.retriever_axis,))
+
+    def __post_init__(self):
+        # Move catalog factors device-resident so queries run through a
+        # compiled top-k program (the fused Pallas kernel on TPU, plain
+        # XLA elsewhere). Building the retriever on the NEW bundle before
+        # the swap is the double-buffered /reload: the old bundle keeps
+        # serving until this one is fully on-device.
+        import jax
+
+        mode = str((self.retrieval or {}).get("mode", "exact")).lower()
+        if (jax.default_backend() != "tpu" and self.retriever_mesh is None
+                and mode != "ann"):
             return
         for model in self.result.models:
-            if self.retriever_mesh is not None:
+            mesh = self._resolved_mesh(model)
+            if mode == "ann":
+                # ANN outranks a configured mesh: the index is the
+                # scale mechanism, and the retriever handles its own
+                # exact fallback (small catalog / failed build)
+                attach = getattr(model, "attach_ann_retriever", None)
+                args = ()
+                kwargs = {k: v for k, v in (self.retrieval or {}).items()
+                          if k != "mode"}
+            elif mesh is not None:
                 attach = getattr(model, "attach_sharded_retriever", None)
-                args = (self.retriever_mesh,)
+                args = (mesh,)
                 kwargs = {"axis": self.retriever_axis}
             else:
                 attach = getattr(model, "attach_retriever", None)
                 args, kwargs = (), {}
+                if jax.default_backend() != "tpu":
+                    # auto resolved to 1-way on a non-TPU backend: host
+                    # scoring is the exact single-device path there
+                    attach = None
             if attach is not None:
                 try:
                     attach(*args, **kwargs)
                     log.info(
                         "%s retriever attached to %s",
-                        "sharded" if self.retriever_mesh is not None
-                        else "device",
+                        "ann" if mode == "ann"
+                        else "sharded" if mesh is not None else "device",
                         type(model).__name__)
                 except Exception:  # pragma: no cover - serving must not die
                     log.exception("device retriever attach failed; "
@@ -215,6 +261,7 @@ class EngineServer:
         rate_limit_qps: float = 0.0,
         rate_limit_burst: float = 0.0,
         brownout_topk: int = 10,
+        retrieval: dict | None = None,
     ):
         self.engine = engine
         self.ctx = ctx or Context(mode="Serving")
@@ -229,13 +276,13 @@ class EngineServer:
             self.deployed = Deployed(
                 inst, result,
                 retriever_mesh=retriever_mesh, retriever_axis=retriever_axis,
-                prewarm_batch=batch_max)
+                prewarm_batch=batch_max, retrieval=retrieval)
         else:  # explicitly pinned instance: fail loud, never substitute
             self.deployed = Deployed(
                 instance,
                 prepare_deploy(engine, instance, self.ctx, engine_dir=engine_dir),
                 retriever_mesh=retriever_mesh, retriever_axis=retriever_axis,
-                prewarm_batch=batch_max)
+                prewarm_batch=batch_max, retrieval=retrieval)
         self.feedback_url = feedback_url
         self.access_key = access_key
         # lifecycle-owned feedback publisher: one shared session, tracked
@@ -677,7 +724,10 @@ class EngineServer:
         fresh = Deployed(fresh_inst, result,
                          retriever_mesh=self.deployed.retriever_mesh,
                          retriever_axis=self.deployed.retriever_axis,
-                         prewarm_batch=self.batch_max)
+                         prewarm_batch=self.batch_max,
+                         # /reload preserves the ANN configuration (and
+                         # rebuilds the index over the fresh factors)
+                         retrieval=self.deployed.retrieval)
         self.deployed = fresh  # atomic reference swap
         self.deploy_skips = skips
         log.info("Reloaded engine instance %s", fresh_inst.id)
@@ -697,6 +747,22 @@ class EngineServer:
             "algorithms": [type(a).__name__ for a in self.deployed.result.algorithms],
             **({"batching": self.batcher.stats()} if self.batcher else {}),
         }
+
+    def _retrieval_stats(self) -> dict | None:
+        """The deployed bundle's retrieval posture: the first attached
+        retriever's stats() (AnnRetriever: index cells / nprobe /
+        quantize / build seconds / exact-fallback flag), a plain mode
+        marker for exact device retrievers, None when serving from host
+        scoring."""
+        for model in self.deployed.result.models:
+            r = getattr(model, "_retriever", None)
+            if r is None:
+                continue
+            if hasattr(r, "stats"):
+                return r.stats()
+            return {"mode": "exact", "nTotal": getattr(r, "n_total", None),
+                    "sharded": type(r).__name__ == "ShardedDeviceRetriever"}
+        return None
 
     def serving_stats(self) -> dict:
         """Machine-readable serving telemetry (GET /stats.json): request
@@ -726,6 +792,9 @@ class EngineServer:
             },
             "batching": self.batcher.stats() if self.batcher else None,
             "execCache": EXEC_CACHE.stats(),
+            # ISSUE 7: the active retrieval mode + ANN index facts
+            # (cells / nprobe / quantize / build seconds / fallback)
+            "retrieval": self._retrieval_stats(),
             "admission": (self.admission.stats()
                           if self.admission is not None else None),
             "resilience": {

@@ -47,6 +47,11 @@ Sites instrumented in this repo:
   generator's timed loop (``tools/serve_bench.sweep``), before each
   device top-k call; arm ``slow`` to model a degraded device under
   generated load and watch the latency histogram move
+- ``retrieval.ann_build``   — head of the ANN index construction at
+  deploy/reload time (``ops/ann.AnnRetriever``; sync site; an
+  ``error`` proves a failed k-means/index build degrades the deploy
+  to exact retrieval — ``pio_retrieval_exact_fallback`` 1 — instead
+  of failing it)
 
 A fault is armed per site with a kind:
 
@@ -91,6 +96,7 @@ SITES: tuple[str, ...] = (
     "train.persist",
     "admission.decide",
     "loadgen.slow_device",
+    "retrieval.ann_build",
 )
 
 #: chaos runs must always be measurable: one counter series per site,

@@ -58,6 +58,12 @@ def pytest_configure(config):
         "(workflow/admission.py, the engine server's overload surfaces "
         "and the event server's 429 path — test_overload.py); chaos-"
         "guarded when also marked chaos; select with -m overload")
+    config.addinivalue_line(
+        "markers",
+        "retrieval: ANN / exact retrieval tests (the quantized IVF index, "
+        "its exact-fallback and parity contracts, and the adaptive "
+        "shard-count cost model — ops/ann.py, ops/retrieval.py; "
+        "test_ann.py); select with -m retrieval")
 
 
 #: Hard per-test budget for chaos tests. Injected hangs are capped at

@@ -128,6 +128,31 @@ def test_pio_deploy_help_documents_overload_flags(tmp_path):
         assert flag in out.stdout, f"{flag} missing from deploy --help"
 
 
+def test_pio_bench_serve_help_documents_retrieval_flag(tmp_path):
+    """ISSUE 7 satellite: `pio bench serve --help` must advertise the
+    retrieval-mode switch (and both its choices) plus the 'auto' mesh
+    width, so the Retrieval-at-scale runbook stays honest."""
+    env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
+    out = subprocess.run([str(REPO / "bin" / "pio"), "bench", "serve",
+                          "--help"],
+                         capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    assert "--retrieval" in out.stdout
+    assert "{exact,ann}" in out.stdout
+    assert "auto" in out.stdout
+
+
+def test_pio_deploy_help_documents_retrieval_flags(tmp_path):
+    """`pio deploy --help`: the ANN mode override and the auto mesh."""
+    env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
+    out = subprocess.run([str(REPO / "bin" / "pio"), "deploy", "--help"],
+                         capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    assert "--retrieval-mode" in out.stdout
+    assert "--retriever-mesh" in out.stdout
+    assert "auto" in out.stdout
+
+
 def test_pio_train_help_documents_supervision_flags(tmp_path):
     """The preemption-tolerance knobs are operator surface: `pio train
     --help` must advertise the supervised-retry / budget flags the
