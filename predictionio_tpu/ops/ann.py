@@ -115,16 +115,23 @@ def pick_cells(n_total: int) -> int:
 
 def effective_nprobe(nprobe: int, k: int, n_cells: int, cell_len: int) -> int:
     """Probe budget for one query: ``nprobe`` scaled by sqrt(k /
-    NPROBE_REF_K) — half the cells for a quarter of the k — floored so
-    the probed rows can still hold k results, capped at ``nprobe``.
+    NPROBE_REF_K) — half the cells for a quarter of the k — capped at
+    ``nprobe``, then floored so the probed rows can still hold k
+    results. The floor OVERRIDES the cap: the compiled program calls
+    ``top_k(candidates, k)`` and under-gathering is a shape error, not
+    a recall loss (and the floor is always satisfiable because
+    n_cells * cell_len >= n_total >= k_pad). When the floor reaches
+    n_cells the caller's full-cover path delegates to exact.
     A full-cover budget (nprobe >= n_cells) is never reduced: it is the
     exact-parity contract, not a performance setting."""
     nprobe = max(1, min(int(nprobe), n_cells))
     if nprobe >= n_cells:
         return n_cells
     min_probe = max(1, math.ceil(k / max(1, cell_len)))
+    if min_probe >= n_cells:
+        return n_cells
     eff = math.ceil(nprobe * math.sqrt(max(1, k) / NPROBE_REF_K))
-    return max(min(max(eff, min_probe), nprobe), 1)
+    return max(min(eff, nprobe), min_probe)
 
 
 def kmeans_centroids(items: np.ndarray, n_cells: int, *, iters: int = 30,
@@ -178,18 +185,37 @@ def _capped_labels(items: np.ndarray, cent: np.ndarray, cap: int,
         o = np.argsort(pd, axis=1, kind="stable")
         ranks[i:i + 65_536] = np.take_along_axis(part, o, axis=1)
         d1[i:i + 65_536] = pd[np.arange(len(pd)), o[:, 0]]
+    # Vectorized nearest-first placement: one pass per fanout rank, not
+    # one Python iteration per item (O(N) interpreter loops are minutes
+    # at 100M rows). Within a pass, items are grouped by candidate cell
+    # (stable sort keeps the confident-first order inside each group)
+    # and each group accepts up to its remaining capacity.
     labels = np.full(n, -1, np.int32)
     counts = np.zeros(n_cells, np.int64)
-    for idx in np.argsort(d1, kind="stable"):  # confident items first
-        for c in ranks[idx]:
-            if counts[c] < cap:
-                labels[idx] = c
-                counts[c] += 1
-                break
-        else:  # every ranked cell full: take the globally emptiest
-            c = int(np.argmin(counts))
-            labels[idx] = c
-            counts[c] += 1
+    remaining = np.argsort(d1, kind="stable")  # confident items first
+    for r in range(fanout):
+        if not len(remaining):
+            break
+        cand = ranks[remaining, r].astype(np.int64)
+        o = np.argsort(cand, kind="stable")
+        sc = cand[o]
+        first = np.r_[True, sc[1:] != sc[:-1]]
+        run_start = np.maximum.accumulate(
+            np.where(first, np.arange(len(sc)), 0))
+        pos = np.arange(len(sc)) - run_start  # rank within the cell group
+        ok = pos < (cap - counts[sc])
+        placed = np.zeros(len(remaining), bool)
+        placed[o[ok]] = True
+        labels[remaining[placed]] = cand[placed]
+        counts += np.bincount(sc[ok], minlength=n_cells)
+        remaining = remaining[~placed]
+    if len(remaining):
+        # every ranked cell full: pack the emptiest cells' free slots
+        # (total capacity n_cells * cap >= n, so slots always suffice)
+        free = np.maximum(cap - counts, 0)
+        cell_order = np.argsort(counts, kind="stable")
+        slots = np.repeat(cell_order, free[cell_order])[:len(remaining)]
+        labels[remaining] = slots.astype(np.int32)
     return labels
 
 
@@ -259,10 +285,15 @@ def build_index(items: np.ndarray, *, n_cells: int | None = None,
 
 class AnnRetriever:
     """Serving-surface twin of ``DeviceRetriever`` (``topk`` /
-    ``prewarm`` / ``n_total``) over an IVF index. Always owns an exact
-    compiled program too — the delegate for full-cover probes, the
+    ``prewarm`` / ``n_total``) over an IVF index. Can always produce an
+    exact compiled program too — the delegate for full-cover probes, the
     fallback for small catalogs and failed builds (so a deploy
-    configured ``mode: ann`` can never be LESS available than exact)."""
+    configured ``mode: ann`` can never be LESS available than exact).
+    The delegate is built LAZILY on first use: the padded cells already
+    cost up to ``max_cell_factor`` x the catalog in HBM, and a
+    replicated full-precision copy on top of that is exactly what will
+    not fit at the catalog sizes ANN exists for (the host f32 array is
+    kept instead — RAM, not HBM)."""
 
     def __init__(self, items: np.ndarray, *, nprobe: int = DEFAULT_NPROBE,
                  quantize: str = "int8", n_cells: int | None = None,
@@ -278,9 +309,12 @@ class AnnRetriever:
         self.min_items = max(0, int(min_items))
         self.last_effective_nprobe: int | None = None
         self._token = next(_RETRIEVER_TOKENS)
-        # the exact program: delegate target AND fallback — built first
-        # so a failed index build leaves a fully serving retriever
-        self._exact = DeviceRetriever(items, interpret=interpret)
+        # the exact delegate/fallback is built lazily from this host
+        # copy — only the full-cover / fallback / empty-k paths pay its
+        # HBM, not every ANN deploy
+        self._items = items
+        self._interpret = interpret
+        self._exact_cached: DeviceRetriever | None = None
         self.index: AnnIndex | None = None
         self.fallback_reason: str | None = None
         if self.n_total < max(self.min_items, 2):
@@ -311,6 +345,13 @@ class AnnRetriever:
         else:
             _M_CELLS.set(0)
             _M_FALLBACK.set(1)
+
+    @property
+    def _exact(self) -> DeviceRetriever:
+        if self._exact_cached is None:
+            self._exact_cached = DeviceRetriever(self._items,
+                                                 interpret=self._interpret)
+        return self._exact_cached
 
     # -- compiled ANN program ---------------------------------------------
     def _build_call(self, b_pad: int, k_pad: int, eff: int, *,

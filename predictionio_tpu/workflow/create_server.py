@@ -179,16 +179,18 @@ class Deployed:
                 and mode != "ann"):
             return
         for model in self.result.models:
-            mesh = self._resolved_mesh(model)
+            mesh = None
             if mode == "ann":
                 # ANN outranks a configured mesh: the index is the
                 # scale mechanism, and the retriever handles its own
-                # exact fallback (small catalog / failed build)
+                # exact fallback (small catalog / failed build) — so
+                # the mesh is never resolved here (running the "auto"
+                # cost model would log a width that is then discarded)
                 attach = getattr(model, "attach_ann_retriever", None)
                 args = ()
                 kwargs = {k: v for k, v in (self.retrieval or {}).items()
                           if k != "mode"}
-            elif mesh is not None:
+            elif (mesh := self._resolved_mesh(model)) is not None:
                 attach = getattr(model, "attach_sharded_retriever", None)
                 args = (mesh,)
                 kwargs = {"axis": self.retriever_axis}

@@ -132,6 +132,29 @@ def test_effective_nprobe_contract():
     assert effective_nprobe(40, 256, 500, 16) >= 16
 
 
+def test_k_floor_overrides_nprobe_cap(rng):
+    """When ceil(k_pad / cell_len) exceeds nprobe the floor must WIN —
+    the compiled program calls top_k(candidates, k_pad), so an
+    under-gathered buffer is a shape error on the serving path, not a
+    recall trade. When the floor covers every cell, full-cover
+    delegation to exact takes over."""
+    # floor beats the configured cap (2 > nprobe=1; 55 > nprobe=52)
+    assert effective_nprobe(1, 30, 4, 16) == 2
+    assert effective_nprobe(52, 14_000, 64, 256) == 55
+    # floor reaching n_cells means full cover -> exact delegate
+    assert effective_nprobe(1, 1_000, 4, 16) == 4
+    # end to end (the review repro): 30 items, 4 cells, nprobe=1, k=40
+    # used to raise inside lax.top_k; it must serve like any valid query
+    items = _clustered(rng, 100, 16)[:30]
+    ann = AnnRetriever(items, min_items=0, n_cells=4, nprobe=1)
+    v, i = ann.topk(rng.standard_normal(16).astype(np.float32), 40)
+    v, i = np.asarray(v), np.asarray(i)
+    assert v.shape == (30,) and i.shape == (30,)
+    assert ann.last_effective_nprobe < 4  # a real probe, not a delegate
+    got = i[i >= 0]
+    assert len(got) == len(set(got)) > 0  # valid, deduplicated ids
+
+
 def test_brownout_clamp_shrinks_probe_work(rng):
     """Satellite 1: the PR-6 brownout top-k clamp must reduce ANN
     rescore work (fewer probed cells), not post-hoc truncate a full
