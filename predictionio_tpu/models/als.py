@@ -660,6 +660,20 @@ def _process_local_slice(arr, sharding):
     return out
 
 
+def _host_global(arr):
+    """Full host copy of a device array regardless of process topology:
+    fully-addressable arrays (single process, or replicated factors)
+    transfer directly; multi-process model-sharded arrays allgather their
+    per-process shards first. Checkpoints and the final model need the
+    TRUE global matrix — the sharded checkpointer then writes only this
+    process's row slice of it."""
+    if getattr(arr, "is_fully_addressable", True):
+        return np.asarray(arr)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+
 def _solve_side(buckets, layout, other, *, kw, x0=None):
     """One side's full half-step over the permuted layout:
 
@@ -893,9 +907,16 @@ def train_als(ratings: Ratings, config: ALSConfig, mesh=None, *,
 
     def _to_slots(host_arr, lay):
         """True-row-order host array -> permuted device layout (non-owner
-        slots stay exactly zero: padded ids gather from them)."""
+        slots stay exactly zero: padded ids gather from them). This is
+        where a restored GLOBAL checkpoint state — possibly reassembled
+        from a different process count's shards — gets re-sliced for the
+        CURRENT mesh: every process holds the same host array and
+        contributes only its device-local slice under multi-process."""
         perm = np.zeros((lay.slots, rank), np.float32)
         perm[lay.pos] = np.asarray(host_arr)
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(
+                fac, _process_local_slice(perm, fac), global_shape=perm.shape)
         return jax.device_put(perm, fac)
 
     # run fingerprint: a checkpoint is only resumable for the exact same
@@ -1003,8 +1024,8 @@ def train_als(ratings: Ratings, config: ALSConfig, mesh=None, *,
             # with v_k, so v alone cannot reconstruct it exactly.
             # checkpoints hold true-row-order arrays — they must be
             # resumable under any mesh/layout permutation
-            checkpointer.save(done, {"u": np.asarray(u)[u_lay.pos],
-                                     "v": np.asarray(v)[i_lay.pos],
+            checkpointer.save(done, {"u": _host_global(u)[u_lay.pos],
+                                     "v": _host_global(v)[i_lay.pos],
                                      "it": np.int64(done),
                                      "fp": np.uint64(fp)})
     if u is None:
@@ -1020,8 +1041,8 @@ def train_als(ratings: Ratings, config: ALSConfig, mesh=None, *,
     log.info("ALS done: %d iters, U %s, V %s", config.iterations, (nu, rank), (ni, rank))
 
     return ALSModel(
-        user_factors=np.asarray(u)[u_lay.pos],
-        item_factors=np.asarray(v)[i_lay.pos],
+        user_factors=_host_global(u)[u_lay.pos],
+        item_factors=_host_global(v)[i_lay.pos],
         user_ids=ratings.user_ids,
         item_ids=ratings.item_ids,
         config=config,

@@ -25,8 +25,18 @@ log = logging.getLogger("predictionio_tpu.parallel")
 
 __all__ = [
     "make_mesh", "data_sharding", "replicated", "shard_batch",
-    "init_distributed", "local_device_count",
+    "init_distributed", "local_device_count", "host_row_range",
 ]
+
+
+def _env_int(name: str) -> int | None:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f"environment variable {name}={raw!r} is not an integer")
 
 
 def init_distributed(
@@ -35,11 +45,38 @@ def init_distributed(
     process_id: int | None = None,
 ) -> None:
     """Multi-host bring-up (DCN control plane). No-op when single-process
-    env vars are absent and no args are given."""
+    env vars are absent and no args are given.
+
+    Partial configuration fails LOUD: once a coordinator address is given
+    (argument or ``JAX_COORDINATOR_ADDRESS``), ``num_processes`` and
+    ``process_id`` must resolve too (argument, or ``JAX_NUM_PROCESSES`` /
+    ``JAX_PROCESS_ID``). Passing ``None``s through to
+    ``jax.distributed.initialize`` would either hang waiting on cluster
+    auto-detection or join with a wrong topology — an unusable run that
+    looks alive.
+    """
     import jax
 
-    if coordinator_address is None and "JAX_COORDINATOR_ADDRESS" not in os.environ:
+    coordinator_address = (
+        coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS") or None)
+    if coordinator_address is None:
         return
+    if num_processes is None:
+        num_processes = _env_int("JAX_NUM_PROCESSES")
+    if process_id is None:
+        process_id = _env_int("JAX_PROCESS_ID")
+    missing = [name for name, val in (("num_processes", num_processes),
+                                      ("process_id", process_id)) if val is None]
+    if missing:
+        raise ValueError(
+            f"init_distributed: coordinator address {coordinator_address!r} "
+            f"is set but {' and '.join(missing)} unresolved — pass them as "
+            "arguments (pio train --num-processes/--process-id) or set "
+            "JAX_NUM_PROCESSES/JAX_PROCESS_ID")
+    if not (0 <= process_id < num_processes):
+        raise ValueError(
+            f"init_distributed: process_id {process_id} out of range for "
+            f"num_processes {num_processes}")
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
@@ -49,6 +86,31 @@ def init_distributed(
         "jax.distributed initialized: process %d/%d",
         jax.process_index(), jax.process_count(),
     )
+
+
+def host_row_range(n_rows: int, process_id: int | None = None,
+                   num_processes: int | None = None) -> tuple[int, int]:
+    """Contiguous ``[lo, hi)`` slice of an ``n_rows`` axis owned by
+    ``process_id`` of ``num_processes`` — the canonical row partition
+    shared by sharded checkpoints and the N→M resharding loader, so any
+    writer/reader pair agrees on shard boundaries without negotiation.
+
+    Balanced: the first ``n_rows % P`` processes get one extra row.
+    Defaults to the live jax process topology.
+    """
+    if process_id is None or num_processes is None:
+        import jax
+
+        process_id = jax.process_index() if process_id is None else process_id
+        num_processes = (jax.process_count() if num_processes is None
+                         else num_processes)
+    if num_processes < 1 or not (0 <= process_id < num_processes):
+        raise ValueError(
+            f"host_row_range: process {process_id}/{num_processes} invalid")
+    base, extra = divmod(n_rows, num_processes)
+    lo = process_id * base + min(process_id, extra)
+    hi = lo + base + (1 if process_id < extra else 0)
+    return lo, hi
 
 
 def local_device_count() -> int:

@@ -106,6 +106,12 @@ class EngineInstance:
     #: attempt (tracing.phase_times_json) — `pio status` shows where the
     #: run's wall clock went. Empty for pre-telemetry records.
     phase_times: str = ""
+    #: JSON map of per-process liveness for elastic multi-host runs:
+    #: ``{"<process_id>": {"ts": iso, "attempt": n}}``. Each process of
+    #: the run stamps its own entry; ``pio status`` shows all of them and
+    #: ``supervisor.check_peer_liveness`` raises ``HostLostError`` when a
+    #: peer's goes stale. Empty for single-host / pre-elastic records.
+    host_heartbeats: str = ""
 
 
 @dataclass(frozen=True)

@@ -319,6 +319,19 @@ def cmd_train(args) -> int:
     from ..workflow import Context, WorkflowParams, run_train
 
     _enable_compile_cache()
+    # elastic multi-host bring-up BEFORE any jax device use; partial
+    # config (coordinator without topology) fails loud in init_distributed
+    num_processes = args.num_processes if args.num_processes is not None else 1
+    process_id = args.process_id if args.process_id is not None else 0
+    if (args.coordinator or args.num_processes is not None
+            or args.process_id is not None):
+        from ..parallel.mesh import init_distributed
+
+        init_distributed(
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
     engine_dir = Path(args.engine_dir)
     _verify_template_min_version(engine_dir)
     variant = _load_variant(engine_dir, args.engine_json)
@@ -339,6 +352,8 @@ def cmd_train(args) -> int:
         checkpoint_dir=args.checkpoint_dir,
         checkpoint_every=args.checkpoint_every,
         profile_dir=args.profile_dir,
+        process_id=process_id,
+        num_processes=num_processes,
     )
     iid = run_train(
         engine,
@@ -352,6 +367,8 @@ def cmd_train(args) -> int:
         max_retries=args.max_retries,
         retry_backoff_s=args.retry_backoff_s,
         train_budget_s=args.train_budget_s or None,
+        process_id=process_id,
+        num_processes=num_processes,
     )
     _ok(f"Training completed. Engine instance: {iid}")
     return 0
@@ -708,7 +725,10 @@ def cmd_status(args) -> int:
     for repo, st in statuses.items():
         _ok(f"  {repo}: {st}")
     try:
-        from ..workflow.supervisor import DEFAULT_STALE_AFTER_S, heartbeat_age_s
+        from ..workflow.supervisor import (
+            DEFAULT_PEER_STALE_AFTER_S, DEFAULT_STALE_AFTER_S,
+            heartbeat_age_s, host_heartbeats)
+        from datetime import datetime, timezone
 
         running = Storage.get_metadata().engine_instance_get_by_status("INIT")
         for inst in running:
@@ -721,8 +741,40 @@ def cmd_status(args) -> int:
                 shown = f"{age:.0f}s ago"
             _ok(f"  training run {inst.id}: INIT, attempt={inst.attempt}, "
                 f"last heartbeat {shown} [{mark}]")
+            # elastic multi-host runs: one liveness line per process
+            now = datetime.now(timezone.utc)
+            for pid, entry in sorted(host_heartbeats(inst).items()):
+                from ..workflow.supervisor import _parse_iso
+
+                ts = _parse_iso(entry.get("ts", ""))
+                h_age = (now - ts).total_seconds() if ts else None
+                h_mark = ("live" if h_age is not None
+                          and h_age < DEFAULT_PEER_STALE_AFTER_S
+                          else "stale — peer presumed lost")
+                h_shown = f"{h_age:.0f}s ago" if h_age is not None else "never"
+                _ok(f"    host {pid}: attempt={entry.get('attempt', 0)}, "
+                    f"heartbeat {h_shown} [{h_mark}]")
     except Exception as e:  # noqa: BLE001 — status must keep printing
         _ok(f"  training runs: unavailable ({e})")
+    if getattr(args, "checkpoint_dir", None):
+        try:
+            from ..workflow.checkpoint import ShardedTrainCheckpointer
+
+            st = ShardedTrainCheckpointer(args.checkpoint_dir).shard_status()
+            latest = (st["latest_complete"] if st["latest_complete"] is not None
+                      else "none")
+            _ok(f"  checkpoints at {args.checkpoint_dir}: "
+                f"complete steps {st['complete']}, latest complete {latest}")
+            if st["partial"]:
+                _ok(f"    partial step(s) {st['partial']} — incomplete save "
+                    "(no manifest); discarded at next resume")
+            for entry in st["discarded"]:
+                _ok(f"    discarded partial step {entry['step']} "
+                    f"({entry['reason']}, {entry.get('ts', '?')})")
+            for pid, step in sorted(st["hosts"].items()):
+                _ok(f"    host {pid}: newest shard at step {step}")
+        except Exception as e:  # noqa: BLE001
+            _ok(f"  checkpoints at {args.checkpoint_dir}: unavailable ({e})")
     try:
         done = Storage.get_metadata().engine_instance_get_by_status("COMPLETED")
         for inst in done[:3]:  # newest first; keep status terse
@@ -863,6 +915,19 @@ def build_parser() -> argparse.ArgumentParser:
                     help="wall-clock budget for the whole training run; "
                          "past it the run aborts cleanly with status "
                          "ABORTED instead of hanging (0 = unlimited)")
+    sp.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                    help="jax.distributed coordinator address for elastic "
+                         "multi-host training; every process passes the "
+                         "same address (process 0 hosts it)")
+    sp.add_argument("--num-processes", type=int, default=None,
+                    help="total process count of the multi-host run; with "
+                         "--checkpoint-dir each process writes only its "
+                         "factor shard and a later run at a DIFFERENT "
+                         "count resumes from the same manifests (N->M "
+                         "elastic resume)")
+    sp.add_argument("--process-id", type=int, default=None,
+                    help="this process's id in [0, --num-processes); "
+                         "process 0 commits checkpoint manifests")
 
     sp = sub.add_parser("eval")
     _add_engine_args(sp)
@@ -1004,6 +1069,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--port", type=int, default=9000)
 
     sp = sub.add_parser("status")
+    sp.add_argument("--checkpoint-dir", default=None,
+                    help="also report this elastic (sharded) checkpoint "
+                         "directory: complete/partial steps, discarded "
+                         "partial-save history, per-host shard state")
 
     sp = sub.add_parser("admin")
     a_sub = sp.add_subparsers(dest="admin_command", required=True)

@@ -54,6 +54,8 @@ class Context:
         checkpoint_dir: str | None = None,
         checkpoint_every: int = 0,
         profile_dir: str | None = None,
+        process_id: int = 0,
+        num_processes: int = 1,
     ):
         self.mode = mode
         self.batch = batch
@@ -68,6 +70,11 @@ class Context:
         self.checkpoint_every = checkpoint_every
         #: jax.profiler trace output dir for this run (workflow/tracing.py)
         self.profile_dir = profile_dir
+        #: elastic multi-host topology (pio train --process-id/
+        #: --num-processes); >1 processes switch checkpointing to the
+        #: sharded manifest protocol
+        self.process_id = process_id
+        self.num_processes = num_processes
         #: set by Engine.train around each algorithm's train() call —
         #: namespaces per-algorithm state such as checkpoints
         self.current_algorithm: str | None = None
@@ -79,16 +86,27 @@ class Context:
         """TrainCheckpointer for this run, or None when checkpointing is
         off (no --checkpoint-dir). The path is namespaced by the algorithm
         currently training (Engine.train sets ``current_algorithm``) so
-        multiple algorithm entries never clobber each other's steps."""
+        multiple algorithm entries never clobber each other's steps.
+
+        Multi-process runs (``num_processes > 1``) get a
+        ``ShardedTrainCheckpointer`` over the same directory: each
+        process writes only its factor shard, process 0 commits the
+        manifest, and a later run at ANY process count resumes from it
+        (N→M elastic resume)."""
         if not self.checkpoint_dir:
             return None
-        from .checkpoint import TrainCheckpointer
+        from .checkpoint import ShardedTrainCheckpointer, TrainCheckpointer
         from pathlib import Path
 
         d = Path(self.checkpoint_dir)
         if self.current_algorithm:
             d = d / self.current_algorithm.replace("/", "_")
-        return TrainCheckpointer(d / subdir if subdir else d)
+        d = d / subdir if subdir else d
+        if self.num_processes > 1:
+            return ShardedTrainCheckpointer(
+                d, process_id=self.process_id,
+                num_processes=self.num_processes)
+        return TrainCheckpointer(d)
 
     # -- devices -----------------------------------------------------------
     @property

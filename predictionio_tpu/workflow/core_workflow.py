@@ -248,6 +248,8 @@ def run_train(
     train_budget_s: float | None = None,
     heartbeat_s: float = 5.0,
     reap_stale_after_s: float = DEFAULT_STALE_AFTER_S,
+    process_id: int = 0,
+    num_processes: int = 1,
 ) -> str:
     """Train and persist; returns the engine instance id
     (CoreWorkflow.runTrain, CoreWorkflow.scala:42-94).
@@ -260,6 +262,12 @@ def run_train(
     ``train_budget_s`` (None = unlimited) bounds the whole run's wall
     clock, aborting cleanly (status ABORTED) instead of hanging. Stale
     INIT orphans from previous dead runs are reaped first.
+
+    Elastic multi-host runs pass ``process_id``/``num_processes``: every
+    heartbeat then also stamps this process's entry in the instance's
+    per-host ``host_heartbeats`` map (the liveness record peers and
+    ``pio status`` read; ``supervisor.check_peer_liveness`` turns a
+    stale entry into a transient ``HostLostError``).
     """
     ctx = ctx or Context(mode="Train", batch=batch)
     meta = Storage.get_metadata()
@@ -295,8 +303,16 @@ def run_train(
     def _on_heartbeat(iso: str, attempt: int) -> None:
         cur = meta.engine_instance_get(instance_id)
         if cur is not None and cur.status == "INIT":  # never clobber a final status
+            extra = {}
+            if num_processes > 1:
+                try:
+                    beats = json.loads(cur.host_heartbeats or "{}")
+                except ValueError:
+                    beats = {}
+                beats[str(process_id)] = {"ts": iso, "attempt": attempt}
+                extra["host_heartbeats"] = json.dumps(beats)
             meta.engine_instance_update(dataclasses.replace(
-                cur, last_heartbeat=iso, attempt=attempt))
+                cur, last_heartbeat=iso, attempt=attempt, **extra))
 
     def _body() -> tuple[int, int]:
         from .tracing import maybe_profile, phase_report, reset_phases

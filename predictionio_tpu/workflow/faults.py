@@ -52,6 +52,19 @@ Sites instrumented in this repo:
   ``error`` proves a failed k-means/index build degrades the deploy
   to exact retrieval — ``pio_retrieval_exact_fallback`` 1 — instead
   of failing it)
+- ``checkpoint.shard_write`` — before a process writes its factor
+  shard in ``ShardedTrainCheckpointer.save`` (sync site; an ``error``
+  models a host dying mid-save — the step stays partial, the manifest
+  never commits, and resume must fall back to the previous complete
+  step)
+- ``checkpoint.manifest_commit`` — on process 0, after every shard is
+  durable but before the manifest rename makes the step complete
+  (sync site; a kill here is the torn-manifest window — all shards on
+  disk, no manifest — and the step must never be loaded)
+- ``train.host_lost``        — head of the cross-host checkpoint
+  barrier (sync site; the sync point where a dead peer surfaces to
+  survivors — arm an ``error`` to prove the surviving process
+  classifies the loss transient and aborts the step cleanly)
 
 A fault is armed per site with a kind:
 
@@ -97,6 +110,9 @@ SITES: tuple[str, ...] = (
     "admission.decide",
     "loadgen.slow_device",
     "retrieval.ann_build",
+    "checkpoint.shard_write",
+    "checkpoint.manifest_commit",
+    "train.host_lost",
 )
 
 #: chaos runs must always be measurable: one counter series per site,

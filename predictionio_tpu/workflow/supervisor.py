@@ -54,16 +54,44 @@ log = logging.getLogger("predictionio_tpu.workflow.supervisor")
 __all__ = [
     "TransientTrainingError", "TrainBudgetExceeded", "classify_error",
     "TrainSupervisor", "reap_orphans", "DEFAULT_STALE_AFTER_S",
+    "HostLostError", "BarrierTimeoutError", "CoordinatorUnreachableError",
+    "host_heartbeats", "stale_peers", "check_peer_liveness",
+    "DEFAULT_PEER_STALE_AFTER_S",
 ]
 
 #: An INIT instance whose heartbeat (or, lacking one, start time) is
 #: older than this is presumed dead and eligible for reaping.
 DEFAULT_STALE_AFTER_S = 600.0
 
+#: A peer process whose per-host heartbeat is older than this is
+#: presumed dead (much tighter than the reaper's 10 min: peers beat at
+#: heartbeat_s≈5 s, and a survivor blocked on a dead peer's barrier
+#: should abort the step, not wait for the orphan reaper).
+DEFAULT_PEER_STALE_AFTER_S = 60.0
+
 
 class TransientTrainingError(RuntimeError):
     """Explicit marker: the wrapped failure is retryable. Engine code can
     raise this around errors the pattern classifier can't know about."""
+
+
+class HostLostError(TransientTrainingError):
+    """A peer process of a multi-host run died (stale peer heartbeat, or
+    its absence surfaced at a sync point). Transient by construction:
+    the supervisor relaunch resumes from the last complete sharded
+    manifest, possibly at a different process count."""
+
+
+class BarrierTimeoutError(TransientTrainingError):
+    """A cross-host barrier (checkpoint shard/manifest sync) timed out —
+    the classic symptom of a dead or wedged peer. Survivors abort the
+    step cleanly and retry/relaunch from the last complete manifest."""
+
+
+class CoordinatorUnreachableError(TransientTrainingError):
+    """The jax.distributed coordinator (or the shared checkpoint
+    filesystem standing in for it) stopped answering. Retryable: a
+    restarted coordinator re-forms the cluster and training resumes."""
 
 
 class TrainBudgetExceeded(RuntimeError):
@@ -90,6 +118,16 @@ _TRANSIENT_PATTERNS = (
     "connection reset",
     "socket closed",
     "transient",
+    # multi-host failure vocabulary: a dead peer / lost coordinator is
+    # the preemption of pod-scale training — always worth a relaunch
+    # from the last complete sharded manifest
+    "barrier timeout",
+    "barrier timed out",
+    "coordinator unreachable",
+    "coordinator disconnected",
+    "host lost",
+    "peer heartbeat",
+    "heartbeat stale",
 )
 
 
@@ -300,6 +338,68 @@ def heartbeat_age_s(instance, *, now: datetime | None = None) -> float | None:
         return (now - last).total_seconds()
     except TypeError:
         return None
+
+
+def host_heartbeats(instance) -> dict[int, dict]:
+    """Per-process liveness stamps from the instance record:
+    ``{process_id: {"ts": iso, "attempt": int, ...}}``. Empty for
+    single-host / pre-elastic records or unparseable JSON — liveness
+    introspection must never throw."""
+    import json
+
+    raw = getattr(instance, "host_heartbeats", "") or ""
+    if not raw:
+        return {}
+    try:
+        parsed = json.loads(raw)
+        return {int(k): dict(v) for k, v in parsed.items()}
+    except (ValueError, TypeError, AttributeError):
+        return {}
+
+
+def stale_peers(
+    instance,
+    *,
+    num_processes: int,
+    stale_after_s: float = DEFAULT_PEER_STALE_AFTER_S,
+    self_id: int | None = None,
+    now: datetime | None = None,
+) -> list[int]:
+    """Process ids of peers presumed dead: never-stamped or stale-stamped
+    entries in the instance's per-host heartbeat map. ``self_id`` is
+    excluded — a process never declares itself lost."""
+    now = now or datetime.now(timezone.utc)
+    beats = host_heartbeats(instance)
+    out = []
+    for pid in range(num_processes):
+        if pid == self_id:
+            continue
+        entry = beats.get(pid)
+        ts = _parse_iso(entry.get("ts", "")) if entry else None
+        if ts is None or (now - ts).total_seconds() >= stale_after_s:
+            out.append(pid)
+    return out
+
+
+def check_peer_liveness(
+    instance,
+    *,
+    num_processes: int,
+    stale_after_s: float = DEFAULT_PEER_STALE_AFTER_S,
+    self_id: int | None = None,
+    now: datetime | None = None,
+) -> None:
+    """Raise ``HostLostError`` (transient) when any peer's heartbeat in
+    the instance record has gone stale — the survivor-side detection of
+    a dead worker, checked between steps so the surviving processes
+    abort cleanly instead of wedging on the next barrier."""
+    dead = stale_peers(instance, num_processes=num_processes,
+                       stale_after_s=stale_after_s, self_id=self_id, now=now)
+    if dead:
+        raise HostLostError(
+            f"host lost: peer heartbeat stale (> {stale_after_s:.0f}s) for "
+            f"process(es) {dead} of {num_processes}; aborting step — "
+            "relaunch resumes from the last complete sharded manifest")
 
 
 def reap_orphans(

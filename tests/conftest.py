@@ -103,6 +103,39 @@ def _chaos_guard(request):
         FAULTS.clear()
 
 
+#: Hard per-test budget for multihost tests. The subprocess helpers in
+#: test_multihost.py already bound each worker's communicate(); this
+#: alarm is the outer backstop that keeps a wedged barrier or stuck
+#: spawn from eating the tier-1 870 s budget.
+MULTIHOST_TEST_TIMEOUT_S = 360
+
+
+@pytest.fixture(autouse=True)
+def _multihost_guard(request):
+    """For @pytest.mark.multihost tests: SIGALRM watchdog above the
+    per-worker subprocess timeouts (pytest-timeout is not in the image).
+    Composes with _chaos_guard by arming only when that guard didn't."""
+    if (request.node.get_closest_marker("multihost") is None
+            or request.node.get_closest_marker("chaos") is not None
+            or request.node.get_closest_marker("train_chaos") is not None):
+        yield
+        return
+
+    import signal
+
+    def _expired(signum, frame):
+        raise TimeoutError(
+            f"multihost test exceeded {MULTIHOST_TEST_TIMEOUT_S}s guard")
+
+    old_handler = signal.signal(signal.SIGALRM, _expired)
+    signal.setitimer(signal.ITIMER_REAL, MULTIHOST_TEST_TIMEOUT_S)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, old_handler)
+
+
 @pytest.fixture(autouse=True)
 def clean_storage():
     """Fresh in-memory storage per test (the reference drops HBase

@@ -165,6 +165,18 @@ def test_pio_train_help_documents_supervision_flags(tmp_path):
         assert flag in out.stdout, f"{flag} missing from train --help"
 
 
+def test_pio_train_help_documents_distributed_flags(tmp_path):
+    """Elastic multi-host launch surface: `pio train --help` must
+    advertise the distributed-topology flags the Elastic multi-host
+    training runbook documents."""
+    env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
+    out = subprocess.run([str(REPO / "bin" / "pio"), "train", "--help"],
+                         capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    for flag in ("--coordinator", "--num-processes", "--process-id"):
+        assert flag in out.stdout, f"{flag} missing from train --help"
+
+
 def test_pio_admin_reap_help_documents_flags(tmp_path):
     env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
     out = subprocess.run([str(REPO / "bin" / "pio"), "admin", "reap",

@@ -24,6 +24,7 @@ import subprocess
 import sys
 from pathlib import Path
 
+import numpy as np
 import pytest
 
 REPO = Path(__file__).resolve().parents[1]
@@ -385,3 +386,276 @@ def test_two_process_sharded_serving_parity(tmp_path):
         got = np.take_along_axis(q @ items.T, idx, axis=1)
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
     assert results[0]["idx"] == results[1]["idx"]
+
+
+# ---------------------------------------------------------------------------
+# elastic multi-host training (ISSUE 8): sharded checkpoints, N→M resume,
+# host-loss tolerance.
+#
+# This container's CPU jaxlib cannot run multi-process XLA collectives
+# ("Multiprocess computations aren't implemented on the CPU backend"), so
+# the elastic workers below do NOT call jax.distributed.initialize — each
+# "host" is its own single-process JAX, and the ONLY coordination between
+# them is the surface under test: the sharded-manifest checkpoint protocol
+# (per-process shards, FileBarrier rendezvous, process-0 manifest commit).
+
+
+def test_init_distributed_fails_loud_on_partial_config(monkeypatch):
+    """ISSUE 8 satellite: a half-configured host must never silently join
+    (or silently skip) a distributed run."""
+    from predictionio_tpu.parallel.mesh import init_distributed
+
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    # no coordinator anywhere → single-host no-op
+    assert init_distributed() is None
+    with pytest.raises(ValueError, match="num_processes and process_id"):
+        init_distributed(coordinator_address="host0:1234")
+    with pytest.raises(ValueError, match="process_id"):
+        init_distributed(coordinator_address="host0:1234", num_processes=2)
+    with pytest.raises(ValueError, match="out of range"):
+        init_distributed(coordinator_address="host0:1234",
+                         num_processes=2, process_id=5)
+
+
+def test_init_distributed_fails_loud_on_partial_env(monkeypatch):
+    from predictionio_tpu.parallel.mesh import init_distributed
+
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "host0:1234")
+    monkeypatch.delenv("JAX_NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("JAX_PROCESS_ID", raising=False)
+    with pytest.raises(ValueError, match="JAX_NUM_PROCESSES"):
+        init_distributed()
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "two")
+    with pytest.raises(ValueError, match="not an integer"):
+        init_distributed()
+
+
+def test_sharded_manifest_resumes_across_topologies(tmp_path):
+    """Loader-level N→M bit parity, no subprocesses: a state saved by N
+    writers reassembles identically for any reader topology M."""
+    import threading
+
+    from predictionio_tpu.workflow.checkpoint import (
+        ShardedTrainCheckpointer, reshard_state)
+
+    rng = np.random.default_rng(4)
+    state = {"u": rng.standard_normal((13, 6)).astype(np.float32),
+             "v": rng.standard_normal((9, 6)).astype(np.float32),
+             "it": np.int64(2), "fp": np.uint64(99)}
+
+    # 1-writer save → 2-process reader slices (1→2)
+    d1 = tmp_path / "n1"
+    ShardedTrainCheckpointer(d1).save(2, state)
+    _, global_state = ShardedTrainCheckpointer(d1).restore()
+    slices = [reshard_state(global_state, process_id=p, num_processes=2)
+              for p in range(2)]
+    np.testing.assert_array_equal(
+        np.concatenate([s["u"] for s in slices]), state["u"])
+    np.testing.assert_array_equal(
+        np.concatenate([s["v"] for s in slices]), state["v"])
+
+    # 2-writer save (threads stand in for the hosts) → 1-process reader
+    # reassembles the global matrices bitwise (2→1)
+    d2 = tmp_path / "n2"
+    cks = [ShardedTrainCheckpointer(d2, process_id=p, num_processes=2,
+                                    barrier_timeout_s=30.0)
+           for p in range(2)]
+    threads = [threading.Thread(target=ck.save, args=(2, state))
+               for ck in cks]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    step, got = ShardedTrainCheckpointer(d2).restore()
+    assert step == 2
+    np.testing.assert_array_equal(got["u"], state["u"])
+    np.testing.assert_array_equal(got["v"], state["v"])
+    assert int(got["it"]) == 2 and int(got["fp"]) == 99
+
+
+def _elastic_ratings():
+    """The deterministic corpus every elastic worker regenerates —
+    np.default_rng is stable across processes, so no storage is needed."""
+    rng = np.random.default_rng(0)
+    nu, ni, n = 40, 30, 600
+    from predictionio_tpu.storage.bimap import BiMap
+    from predictionio_tpu.storage.frame import Ratings
+
+    return Ratings(
+        user_indices=rng.integers(0, nu, n).astype(np.int64),
+        item_indices=rng.integers(0, ni, n).astype(np.int64),
+        ratings=(rng.random(n).astype(np.float32) * 4 + 1),
+        user_ids=BiMap({f"u{i}": i for i in range(nu)}),
+        item_ids=BiMap({f"i{i}": i for i in range(ni)}),
+    )
+
+
+_ELASTIC_PRELUDE = r'''
+import json, os, sys
+
+pid = int(sys.argv[1])
+nproc = int(sys.argv[2])
+ckpt_dir = sys.argv[3]
+
+# 8 virtual devices to MATCH the parent suite's mesh: the parity check
+# compares factors across the kill/resume boundary, and the CG inner
+# solver amplifies device-count-dependent reduction-order noise
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+sys.path.insert(0, %(repo)r)
+
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+from predictionio_tpu.models.als import ALSConfig, train_als
+from predictionio_tpu.storage.bimap import BiMap
+from predictionio_tpu.storage.frame import Ratings
+from predictionio_tpu.workflow.checkpoint import ShardedTrainCheckpointer
+from predictionio_tpu.workflow.faults import FAULTS, FaultInjected
+from predictionio_tpu.workflow.supervisor import classify_error
+
+
+def _elastic_ratings():
+    rng = np.random.default_rng(0)
+    nu, ni, n = 40, 30, 600
+    return Ratings(
+        user_indices=rng.integers(0, nu, n).astype(np.int64),
+        item_indices=rng.integers(0, ni, n).astype(np.int64),
+        ratings=(rng.random(n).astype(np.float32) * 4 + 1),
+        user_ids=BiMap({f"u{i}": i for i in range(nu)}),
+        item_ids=BiMap({f"i{i}": i for i in range(ni)}),
+    )
+
+# cholesky: the exact per-row solver — resume parity is bit-level, free
+# of the CG depth schedule (train_als uses cold depth below 3 iterations)
+cfg = ALSConfig(rank=8, iterations=4, lambda_=0.1, seed=5, solver="cholesky")
+'''
+
+CHAOS_WORKER_SRC = _ELASTIC_PRELUDE + r'''
+ck = ShardedTrainCheckpointer(ckpt_dir, process_id=pid, num_processes=nproc,
+                              barrier_timeout_s=10.0)
+if pid == 1:
+    # host 1 dies at its SECOND shard write: step 1 commits first, then
+    # the host is gone mid-step-2 (the instrumented chaos site IS the
+    # death point — no cleanup, no barrier mark)
+    FAULTS.inject("checkpoint.shard_write", "error", after=1)
+try:
+    train_als(_elastic_ratings(), cfg, checkpointer=ck, checkpoint_every=1)
+    result = {"pid": pid, "outcome": "completed"}
+except FaultInjected:
+    print("RESULT " + json.dumps({"pid": pid, "outcome": "died"}), flush=True)
+    os._exit(0)
+except Exception as e:
+    result = {"pid": pid, "outcome": "aborted",
+              "classification": classify_error(e),
+              "error": type(e).__name__,
+              "complete": ck.steps(), "partial": ck.partial_steps()}
+print("RESULT " + json.dumps(result), flush=True)
+'''
+
+
+@pytest.mark.multihost
+def test_host_loss_mid_run_then_elastic_resume_2_to_1(tmp_path):
+    """ISSUE 8 acceptance: 2-process elastic training, one worker killed
+    mid-step at the `checkpoint.shard_write` chaos site. The survivor
+    classifies the loss transient (barrier timeout) and reports the last
+    complete step; a relaunch at M=1 resumes from the 2-shard step-1
+    manifest, discards the torn step, and converges to parity with an
+    uninterrupted run."""
+    from predictionio_tpu.models.als import ALSConfig, train_als
+    from predictionio_tpu.workflow.checkpoint import ShardedTrainCheckpointer
+    from predictionio_tpu.workflow.faults import FAULTS
+
+    ckpt = tmp_path / "ck"
+    worker = tmp_path / "chaos_worker.py"
+    worker.write_text(CHAOS_WORKER_SRC % {"repo": str(REPO)})
+    results = _run_workers(worker,
+                           lambda pid: [str(pid), "2", str(ckpt)],
+                           240, "host-loss chaos")
+
+    assert results[1]["outcome"] == "died"
+    surv = results[0]
+    assert surv["outcome"] == "aborted"
+    assert surv["error"] == "BarrierTimeoutError"
+    assert surv["classification"] == "transient"  # → supervisor retries
+    assert surv["complete"] == [1]  # step 2 never got a manifest
+    assert surv["partial"] == [2]   # the survivor's lone step-2 shard
+
+    # relaunch at M=1 (2→1): resume from the last complete manifest
+    cfg = ALSConfig(rank=8, iterations=4, lambda_=0.1, seed=5,
+                    solver="cholesky")
+    baseline = train_als(_elastic_ratings(), cfg)
+    ck = ShardedTrainCheckpointer(ckpt)
+    FAULTS.inject("train.step", "slow", delay_s=0.0)  # firing counter only
+    try:
+        resumed = train_als(_elastic_ratings(), cfg,
+                            checkpointer=ck, checkpoint_every=1)
+        # resumed from step 1, not restarted: iterations 2-4 ran
+        assert FAULTS.fired("train.step") == 3
+    finally:
+        FAULTS.clear()
+    # the torn step was discarded and recorded for `pio status`
+    assert [e["step"] for e in ck.discarded()] == [2]
+    assert 2 not in ck.steps()
+    np.testing.assert_allclose(resumed.item_factors, baseline.item_factors,
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(resumed.user_factors, baseline.user_factors,
+                               rtol=1e-5, atol=1e-5)
+
+
+RESUME_WORKER_SRC = _ELASTIC_PRELUDE + r'''
+ck = ShardedTrainCheckpointer(ckpt_dir, process_id=pid, num_processes=nproc,
+                              barrier_timeout_s=60.0)
+FAULTS.inject("train.step", "slow", delay_s=0.0)  # firing counter only
+model = train_als(_elastic_ratings(), cfg, checkpointer=ck, checkpoint_every=1)
+print("RESULT " + json.dumps({
+    "pid": pid,
+    "steps_run": FAULTS.fired("train.step"),
+    "complete": ck.steps(),
+    "u": model.user_factors.tolist(),
+    "v": model.item_factors.tolist(),
+}), flush=True)
+'''
+
+
+@pytest.mark.multihost
+def test_elastic_resume_1_to_2_bit_level_restore(tmp_path):
+    """The other direction (1→2): a single-process run checkpoints 2 of 4
+    iterations, then TWO elastic workers resume from its 1-shard manifest.
+    Both must RESUME (2 device steps each, not 4), agree with each other,
+    and match the uninterrupted single-process run."""
+    from predictionio_tpu.models.als import ALSConfig, train_als
+    from predictionio_tpu.workflow.checkpoint import ShardedTrainCheckpointer
+
+    ckpt = tmp_path / "ck"
+    cfg2 = ALSConfig(rank=8, iterations=2, lambda_=0.1, seed=5,
+                     solver="cholesky")
+    train_als(_elastic_ratings(), cfg2,
+              checkpointer=ShardedTrainCheckpointer(ckpt),
+              checkpoint_every=1)
+    assert ShardedTrainCheckpointer(ckpt).latest_step() == 2
+
+    worker = tmp_path / "resume_worker.py"
+    worker.write_text(RESUME_WORKER_SRC % {"repo": str(REPO)})
+    results = _run_workers(worker,
+                           lambda pid: [str(pid), "2", str(ckpt)],
+                           240, "elastic 1→2 resume")
+
+    for r in results.values():
+        assert r["steps_run"] == 2  # resumed at step 2, ran 3 and 4 only
+        assert r["complete"] == [3, 4]  # keep=2 window advanced
+    # the two hosts computed the same model from the resharded state...
+    np.testing.assert_allclose(np.asarray(results[0]["u"]),
+                               np.asarray(results[1]["u"]),
+                               rtol=1e-6, atol=1e-7)
+    # ...and it matches the uninterrupted 4-iteration run
+    cfg4 = ALSConfig(rank=8, iterations=4, lambda_=0.1, seed=5,
+                     solver="cholesky")
+    baseline = train_als(_elastic_ratings(), cfg4)
+    np.testing.assert_allclose(np.asarray(results[0]["u"]),
+                               baseline.user_factors, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(results[0]["v"]),
+                               baseline.item_factors, rtol=1e-5, atol=1e-5)

@@ -60,13 +60,20 @@ from predictionio_tpu.workflow.create_server import (
 )
 from predictionio_tpu.workflow.faults import FAULTS, FaultInjected
 from predictionio_tpu.workflow.supervisor import (
+    DEFAULT_PEER_STALE_AFTER_S,
     DEFAULT_STALE_AFTER_S,
+    BarrierTimeoutError,
+    CoordinatorUnreachableError,
+    HostLostError,
     TrainBudgetExceeded,
     TrainSupervisor,
     TransientTrainingError,
+    check_peer_liveness,
     classify_error,
     heartbeat_age_s,
+    host_heartbeats,
     reap_orphans,
+    stale_peers,
 )
 from tests.helpers import ServerThread
 
@@ -131,6 +138,76 @@ def test_classifier_transient_errors():
     assert classify_error(TransientTrainingError("wrapped")) == "transient"
     assert classify_error(MemoryError()) == "transient"
     assert classify_error(ConnectionResetError()) == "transient"
+
+
+def test_classifier_multihost_failure_modes():
+    """A lost peer, a timed-out barrier, or an unreachable coordinator is
+    a topology event, not a code bug: the supervisor must retry (the
+    relaunch resumes from the last complete sharded manifest)."""
+    assert classify_error(HostLostError("host lost: peer heartbeat stale "
+                                        "for process(es) [1]")) == "transient"
+    assert classify_error(BarrierTimeoutError(
+        "barrier timeout at 'step2.shards.n2'")) == "transient"
+    assert classify_error(CoordinatorUnreachableError(
+        "coordinator unreachable at host0:1234")) == "transient"
+    # message patterns alone (e.g. surfaced through a RuntimeError from
+    # jax.distributed) classify the same way
+    assert classify_error(RuntimeError("barrier timed out waiting for "
+                                       "peers")) == "transient"
+    assert classify_error(RuntimeError("coordinator unreachable")) == "transient"
+    assert classify_error(RuntimeError("peer heartbeat stale")) == "transient"
+    assert classify_error(RuntimeError("host lost during all-reduce")) == "transient"
+
+
+# ---------------------------------------------------------------------------
+# multi-host peer liveness (host_heartbeats on the instance record)
+
+
+def _mh_instance(beats: dict) -> EngineInstance:
+    import json
+
+    return EngineInstance(id="mh-1", status="INIT",
+                          host_heartbeats=json.dumps(beats))
+
+
+def test_host_heartbeats_parses_and_tolerates_garbage():
+    now = datetime.now(timezone.utc).isoformat()
+    inst = _mh_instance({"0": {"ts": now, "attempt": 1},
+                         "1": {"ts": now, "attempt": 1}})
+    beats = host_heartbeats(inst)
+    assert set(beats) == {0, 1}
+    assert beats[0]["attempt"] == 1
+    # unparseable blob → empty map, never a throw
+    assert host_heartbeats(EngineInstance(id="x", host_heartbeats="{oops")) == {}
+    assert host_heartbeats(EngineInstance(id="y")) == {}
+
+
+def test_stale_peers_flags_stale_and_missing_hosts():
+    now = datetime.now(timezone.utc)
+    fresh = now.isoformat()
+    old = (now - timedelta(seconds=DEFAULT_PEER_STALE_AFTER_S * 3)).isoformat()
+    inst = _mh_instance({"0": {"ts": fresh, "attempt": 1},
+                         "1": {"ts": old, "attempt": 1}})
+    # peer 1 is stale; peer 2 never stamped at all
+    assert stale_peers(inst, num_processes=3, now=now) == [1, 2]
+    # excluding self: process 1 asking about its own staleness is moot
+    assert stale_peers(inst, num_processes=3, self_id=1, now=now) == [2]
+    # all fresh → no stale peers
+    inst2 = _mh_instance({"0": {"ts": fresh}, "1": {"ts": fresh}})
+    assert stale_peers(inst2, num_processes=2, now=now) == []
+
+
+def test_check_peer_liveness_raises_host_lost():
+    now = datetime.now(timezone.utc)
+    old = (now - timedelta(seconds=500)).isoformat()
+    inst = _mh_instance({"0": {"ts": now.isoformat()}, "1": {"ts": old}})
+    with pytest.raises(HostLostError, match="peer heartbeat stale"):
+        check_peer_liveness(inst, num_processes=2, self_id=0, now=now)
+    # and the raise classifies transient end to end
+    try:
+        check_peer_liveness(inst, num_processes=2, self_id=0, now=now)
+    except HostLostError as e:
+        assert classify_error(e) == "transient"
 
 
 # ---------------------------------------------------------------------------
@@ -592,5 +669,7 @@ def test_every_fault_site_documented_in_operations_md():
     missing = [s for s in sites if s not in ops]
     assert not missing, f"chaos sites undocumented in operations.md: {missing}"
     for new_site in ("train.step", "train.persist",
-                     "admission.decide", "loadgen.slow_device"):
+                     "admission.decide", "loadgen.slow_device",
+                     "checkpoint.shard_write", "checkpoint.manifest_commit",
+                     "train.host_lost"):
         assert new_site in sites
