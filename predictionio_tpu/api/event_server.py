@@ -182,14 +182,14 @@ async def _insert_one(
     ingest: DurableIngestor | None = request.app.get(INGEST_KEY)
     if ingest is not None:
         e = ingest.assign_id(event)
-        appended, err = await ingest.submit([e], auth.app_id, auth.channel_id)
-        if appended == 1:
+        statuses, err = await ingest.submit([e], auth.app_id, auth.channel_id)
+        if statuses[0] == "ok":
             # event-path join, middle hop: ingress line -> this line ->
             # the drainer's ingest.drain_batch line, all by trace id
             trace_event("ingest.journal_append", event_id=e.event_id)
             _bump_stats(request, auth.app_id, 201, e)
             return 201, {"eventId": e.event_id}
-        if err is None:
+        if statuses[0] == "full":
             _bump_stats(request, auth.app_id, 503, event)
             return 503, {"message": "event journal at capacity; retry"}
         _bump_stats(request, auth.app_id, 500, event)
@@ -333,19 +333,20 @@ async def handle_post_batch(request: web.Request) -> web.Response:
         valid.append((len(results) - 1, validated))
     ingest: DurableIngestor | None = request.app.get(INGEST_KEY)
     if valid and ingest is not None:
-        # durable mode: ONE journal append run + ONE fsync for the whole
-        # batch (the fsync-amortization point of the `batch` policy); the
-        # backend write happens on the drainer's schedule. A mid-run
-        # JournalFull acks the appended prefix and 503s the rest —
-        # per-event statuses stay exact, nothing is silently dropped.
+        # durable mode: the batch is routed by entity hash and appended
+        # to its journal partitions concurrently, ONE fsync per touched
+        # partition (the fsync-amortization point of the `batch` policy);
+        # the backend writes happen on the drainers' schedules. A full
+        # partition 503s only ITS events — per-event statuses stay
+        # exact, siblings keep acking, nothing is silently dropped.
         withids = [(slot, ingest.assign_id(e)) for slot, e in valid]
-        appended, err = await ingest.submit(
+        statuses, err = await ingest.submit(
             [e for _, e in withids], auth.app_id, auth.channel_id)
-        for i, (slot, e) in enumerate(withids):
-            if i < appended:
+        for (slot, e), s in zip(withids, statuses):
+            if s == "ok":
                 results[slot] = {"status": 201, "eventId": e.event_id}
                 _bump_stats(request, auth.app_id, 201, e)
-            elif err is None:
+            elif s == "full":
                 results[slot] = {"status": 503,
                                  "message": "event journal at capacity; retry"}
                 _bump_stats(request, auth.app_id, 503, e)
@@ -604,22 +605,28 @@ def run_event_server(ip: str = "0.0.0.0", port: int = 7070,
                      stats: bool = False, journal_dir: str | None = None,
                      journal_fsync: str = "batch",
                      journal_max_mb: int = 256,
+                     journal_partitions: int = 1,
                      admission: bool = False,
                      rate_limit_qps: float = 0.0,
                      rate_limit_burst: float = 0.0) -> None:
     """Blocking entry (reference: EventServer.createEventServer,
     EventAPI.scala:449-468; default port 7070). ``journal_dir`` enables
     durable ingestion (ack-from-journal, background drain);
-    ``admission``/``rate_limit_qps`` enable 429 overload shedding on the
-    write endpoints (journal-fill pressure + per-access-key buckets)."""
+    ``journal_partitions`` shards the journal + drainers by entity hash
+    (per-entity ordering, concurrent fsync/drain — docs/operations.md
+    "Ingestion at scale"); ``admission``/``rate_limit_qps`` enable 429
+    overload shedding on the write endpoints (journal-fill pressure +
+    per-access-key buckets)."""
     logging.basicConfig(level=logging.INFO)
     ingestor = None
     if journal_dir:
         ingestor = DurableIngestor(
             journal_dir, fsync=journal_fsync,
-            max_bytes=int(journal_max_mb) * 1024 * 1024)
-        log.info("Durable ingestion: journal at %s (fsync=%s, cap=%dMB)",
-                 journal_dir, journal_fsync, journal_max_mb)
+            max_bytes=int(journal_max_mb) * 1024 * 1024,
+            partitions=journal_partitions)
+        log.info("Durable ingestion: journal at %s (fsync=%s, cap=%dMB, "
+                 "partitions=%d)", journal_dir, journal_fsync,
+                 journal_max_mb, ingestor.partitions)
     controller = None
     if admission or rate_limit_qps > 0:
         controller = AdmissionController(

@@ -5,7 +5,9 @@ Mirrors the capability of the reference's ``data/.../storage`` package
 sqlite and filesystem backends behind the same repository registry.
 """
 
-from .aggregate import EventOp, aggregate_properties, aggregate_properties_single
+from .aggregate import (EventOp, aggregate_properties,
+                        aggregate_properties_frame,
+                        aggregate_properties_single)
 from .bimap import BiMap, string_int_bimap
 from .datamap import DataMap, DataMapError, PropertyMap
 from .event import (
@@ -45,7 +47,8 @@ __all__ = [
     "MemoryEvents", "MetadataStore", "Model", "PropertyMap",
     "Ratings", "SPECIAL_EVENTS", "SQLiteEvents", "Storage", "StorageError",
     "TableNotInitialized", "ValidationError",
-    "aggregate_properties", "aggregate_properties_single",
+    "aggregate_properties", "aggregate_properties_frame",
+    "aggregate_properties_single",
     "event_from_api_dict", "event_from_json", "event_to_api_dict",
     "entity_key", "hash64", "iter_host_shard", "partition_events", "shard_of",
     "event_to_json", "string_int_bimap", "validate_event",

@@ -28,7 +28,7 @@ from dataclasses import dataclass
 from datetime import datetime
 from typing import Any, Iterator, Sequence
 
-from .aggregate import aggregate_properties, aggregate_properties_single
+from .aggregate import aggregate_properties_frame, aggregate_properties_single
 from .datamap import PropertyMap
 from .event import Event
 from .frame import EventFrame
@@ -194,8 +194,13 @@ class EventBackend(abc.ABC):
         until_time: datetime | None = None,
         required: Sequence[str] | None = None,
     ) -> dict[str, PropertyMap]:
-        """$set/$unset/$delete fold per entity (LEvents.scala:153-194)."""
-        events = self.find(
+        """$set/$unset/$delete fold per entity (LEvents.scala:153-194).
+
+        Reads through ``find_frame`` (one columnar scan) and the
+        vectorized frame fold — the ISSUE 9 read pushdown; semantics are
+        pinned bit-identical to the row-at-a-time
+        ``aggregate_properties(self.find(...))`` it replaces."""
+        frame = self.find_frame(
             EventQuery(
                 app_id=app_id,
                 channel_id=channel_id,
@@ -205,7 +210,7 @@ class EventBackend(abc.ABC):
                 event_names=("$set", "$unset", "$delete"),
             )
         )
-        result = aggregate_properties(events)
+        result = aggregate_properties_frame(frame)
         if required:
             result = {
                 k: v

@@ -340,23 +340,34 @@ class SQLiteEvents(EventBackend):
                f"target_entity_id, event_time, properties FROM {table}"
                f"{where} ORDER BY event_time ASC, seq ASC")
         rows = self._conn().execute(sql, params).fetchall()
-        n = len(rows)
-        ev = np.empty(n, dtype=object)
-        et = np.empty(n, dtype=object)
-        ei = np.empty(n, dtype=object)
-        tt = np.empty(n, dtype=object)
-        ti = np.empty(n, dtype=object)
-        tm = np.empty(n, dtype=np.float64)
-        pr: list[dict] = [None] * n  # type: ignore[list-item]
+        if not rows:
+            empty = np.empty(0, dtype=object)
+            return EventFrame(event=empty, entity_type=empty.copy(),
+                              entity_id=empty.copy(),
+                              target_entity_type=empty.copy(),
+                              target_entity_id=empty.copy(),
+                              event_time=np.empty(0, dtype=np.float64),
+                              properties=[])
+        # one C-level transpose instead of 7 assignments per row — the
+        # per-row loop was ~half the 200k-event scan cost
+        ev_c, et_c, ei_c, tt_c, ti_c, tm_c, pj_c = zip(*rows)
         loads = json.loads
-        for i, (e_, et_, ei_, tt_, ti_, tm_, pj) in enumerate(rows):
-            ev[i] = e_
-            et[i] = et_
-            ei[i] = ei_
-            tt[i] = tt_
-            ti[i] = ti_
-            tm[i] = tm_
-            pr[i] = loads(pj) if pj else {}
-        return EventFrame(event=ev, entity_type=et, entity_id=ei,
-                          target_entity_type=tt, target_entity_id=ti,
-                          event_time=tm, properties=pr)
+        # bulk imports repeat property shapes; memoizing on the raw JSON
+        # string skips most of the parse cost. The dicts are therefore
+        # SHARED across rows — EventFrame.properties is a read-only view.
+        memo: dict = {}
+        pr = []
+        for p in pj_c:
+            d = memo.get(p)
+            if d is None:
+                d = loads(p) if p else {}
+                memo[p] = d
+            pr.append(d)
+        return EventFrame(
+            event=np.array(ev_c, dtype=object),
+            entity_type=np.array(et_c, dtype=object),
+            entity_id=np.array(ei_c, dtype=object),
+            target_entity_type=np.array(tt_c, dtype=object),
+            target_entity_id=np.array(ti_c, dtype=object),
+            event_time=np.array(tm_c, dtype=np.float64),
+            properties=pr)

@@ -16,6 +16,7 @@ console/Console.scala:128-1245). Same verb set, no JVM/spark-submit spawning
   pio undeploy [--port 8000]
   pio eventserver [--port 7070] [--stats] [--journal-dir D]
                   [--journal-fsync always|batch|never] [--journal-max-mb N]
+                  [--journal-partitions N]
   pio adminserver [--port 7071]
   pio dashboard [--port 9000]
   pio import|export --appid N --input|--output FILE
@@ -652,6 +653,7 @@ def cmd_eventserver(args) -> int:
                      journal_dir=args.journal_dir,
                      journal_fsync=args.journal_fsync,
                      journal_max_mb=args.journal_max_mb,
+                     journal_partitions=args.journal_partitions,
                      admission=args.admission,
                      rate_limit_qps=args.rate_limit_qps,
                      rate_limit_burst=args.rate_limit_burst)
@@ -1049,6 +1051,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--journal-max-mb", type=int, default=256,
                     help="journal capacity; past it ingestion answers "
                          "503 + Retry-After (backpressure, default 256)")
+    sp.add_argument("--journal-partitions", type=int, default=1,
+                    help="shard the journal + drainers N ways by "
+                         "hash(entityType, entityId): per-entity ordering, "
+                         "concurrent fsync and drain; resizing N requires "
+                         "drained journals (default 1)")
     sp.add_argument("--admission", action="store_true",
                     help="adaptive admission control: shed ingestion "
                          "with 429 + Retry-After when journal fill/lag "

@@ -31,6 +31,16 @@ Sites instrumented in this repo:
 - ``eventserver.drain``     — before each drainer push of journaled
   records into the backend (async site; arm an un-bounded ``error`` for
   a hard storage outage the 201 acks must survive)
+- ``journal.partition_append`` — head of every routed
+  ``PartitionedJournal.append``, before the record reaches its
+  partition's journal (sync site; an ``error`` is a failing disk on the
+  partitioned write path → the API answers 500)
+- ``eventserver.drain_partition`` — fired by every per-partition drainer
+  right after ``eventserver.drain`` (async site); each drainer ALSO
+  fires a dynamic partition-targeted twin
+  ``eventserver.drain_partition.p<k>`` — arm that one to wedge a single
+  partition's drainer and prove a poison partition browns out alone
+  while its siblings keep draining
 - ``train.step``            — top of every ALS training iteration
   (``models/als.train_als``; sync site; arm with ``after=N`` to kill a
   run mid-training once checkpoints exist, proving the supervisor
@@ -105,6 +115,8 @@ SITES: tuple[str, ...] = (
     "journal.append",
     "journal.fsync",
     "eventserver.drain",
+    "journal.partition_append",
+    "eventserver.drain_partition",
     "train.step",
     "train.persist",
     "admission.decide",

@@ -129,3 +129,96 @@ def test_monoid_merge_order_independent():
             assert got.to_dict() == expected["u1"].to_dict()
             assert got.first_updated == expected["u1"].first_updated
             assert got.last_updated == expected["u1"].last_updated
+
+
+# ---------------------------------------------------------------------------
+# Frame-fold parity (ISSUE 9): the vectorized columnar pre-pass must be
+# bit-identical to the row-at-a-time EventOp fold on the same events
+
+
+def _frame(events):
+    from predictionio_tpu.storage.frame import EventFrame
+
+    return EventFrame.from_events(events)
+
+
+def _assert_same(frame_out, row_out):
+    assert set(frame_out) == set(row_out)
+    for eid, pm in row_out.items():
+        got = frame_out[eid]
+        assert got.to_dict() == pm.to_dict(), eid
+        assert got.first_updated == pm.first_updated, eid
+        assert got.last_updated == pm.last_updated, eid
+
+
+def test_frame_fold_matches_reference_fixtures():
+    """Every fixture above, through the columnar path."""
+    from predictionio_tpu.storage import aggregate_properties_frame
+
+    fixtures = [
+        [special("$set", "u1", {"a": 1, "b": 1}, 0),
+         special("$set", "u1", {"b": 2, "c": 3}, 1)],
+        [special("$set", "u1", {"a": 1, "b": 1}, 0),
+         special("$unset", "u1", {"b": None}, 1)],
+        [special("$set", "u1", {"a": 1}, 0),
+         special("$unset", "u1", {"a": None}, 1),
+         special("$set", "u1", {"a": 9}, 2)],
+        [special("$set", "u1", {"a": 1}, 0),
+         special("$delete", "u1", {}, 1)],
+        [special("$set", "u1", {"a": 1, "b": 2}, 0),
+         special("$delete", "u1", {}, 1),
+         special("$set", "u1", {"c": 3}, 2)],
+        [special("$set", "u1", {"a": 1}, 0),
+         Event(event="view", entity_type="user", entity_id="u1",
+               event_time=T0 + timedelta(minutes=5))],
+        [special("$unset", "u2", {"x": None}, 0)],
+        [],
+    ]
+    for events in fixtures:
+        _assert_same(aggregate_properties_frame(_frame(events)),
+                     aggregate_properties(events))
+
+
+def test_frame_fold_equal_time_tie_break():
+    """Equal-timestamp $sets resolve by the serialized-value tie-break in
+    BOTH folds — bulk imports stamp whole batches with one eventTime."""
+    from predictionio_tpu.storage import aggregate_properties_frame
+
+    events = [
+        special("$set", "u1", {"a": "x"}, 7),
+        special("$set", "u1", {"a": "q"}, 7),  # same minute, same key
+    ]
+    for order in (events, events[::-1]):
+        _assert_same(aggregate_properties_frame(_frame(order)),
+                     aggregate_properties(order))
+
+
+def test_frame_fold_multi_entity_randomized_parity():
+    """Randomized multi-entity streams in random order: the frame fold is
+    order-independent (per-entity ordering is all partitioned ingestion
+    guarantees) and identical to both row-at-a-time folds."""
+    from predictionio_tpu.storage import (aggregate_properties_frame,
+                                          aggregate_properties_single)
+
+    rnd = random.Random(11)
+    events = []
+    for m in range(300):
+        eid = f"u{rnd.randrange(17)}"
+        kind = rnd.choice(["$set", "$set", "$set", "$unset", "$delete"])
+        props = ({rnd.choice("abcde"): rnd.randint(0, 9)} if kind == "$set"
+                 else ({rnd.choice("abcde"): None} if kind == "$unset"
+                       else {}))
+        events.append(special(kind, eid, props, m))
+    expected = aggregate_properties(events)
+    for _ in range(5):
+        shuffled = events[:]
+        rnd.shuffle(shuffled)
+        _assert_same(aggregate_properties_frame(_frame(shuffled)), expected)
+    # per-entity parity with the single-entity reference fold
+    for eid in expected:
+        pm = aggregate_properties_single(
+            iter(e for e in events if e.entity_id == eid))
+        frame_pm = aggregate_properties_frame(_frame(events))[eid]
+        assert frame_pm.to_dict() == pm.to_dict()
+        assert frame_pm.first_updated == pm.first_updated
+        assert frame_pm.last_updated == pm.last_updated

@@ -98,7 +98,8 @@ def test_pio_eventserver_help_documents_journal_flags(tmp_path):
     out = subprocess.run([str(REPO / "bin" / "pio"), "eventserver", "--help"],
                          capture_output=True, text=True, env=env, timeout=60)
     assert out.returncode == 0
-    for flag in ("--journal-dir", "--journal-fsync", "--journal-max-mb"):
+    for flag in ("--journal-dir", "--journal-fsync", "--journal-max-mb",
+                 "--journal-partitions"):
         assert flag in out.stdout, f"{flag} missing from eventserver --help"
     for policy in ("always", "batch", "never"):
         assert policy in out.stdout

@@ -326,3 +326,207 @@ def test_kill_mid_append_truncates_torn_tail(tmp_path):
         assert h["status"] == "ok" and h["journal"]["lag"] == 0
     finally:
         s2.stop()
+
+
+# ---------------------------------------------------------------------------
+# Partitioned ingest (ISSUE 9): N journals, N drainers, per-entity order
+
+
+def _entities_by_partition(n, per, entity_type="user"):
+    """Deterministic entity ids grouped by their journal partition."""
+    from predictionio_tpu.storage.partition import shard_of
+
+    out = {k: [] for k in range(n)}
+    i = 0
+    while any(len(v) < per for v in out.values()):
+        eid = f"e{i:04d}"
+        k = shard_of(entity_type, eid, n)
+        if len(out[k]) < per:
+            out[k].append(eid)
+        i += 1
+    return out
+
+
+@pytest.mark.chaos
+def test_partitioned_outage_kill_restart_heal_exactly_once(tmp_path):
+    """The PR-3 acceptance scenario, per partition: a full outage wedges
+    all 8 drainers, then exactly 3 drain batches are let through — 3 of
+    8 partition cursors advance — and the process is killed cold
+    mid-drain. After restart + heal every event lands exactly once and
+    in per-entity order (the partitioned ordering contract)."""
+    app, key = _mk_app_key()
+    n_entities, per_entity = 40, 5
+    total = n_entities * per_entity
+    wal = tmp_path / "wal"
+
+    FAULTS.inject("eventserver.drain", "error")  # outage from the start
+    s = _DurableServer(_fast_ingestor(wal, partitions=8, drain_batch=128))
+    killed = False
+    try:
+        sess = requests.Session()
+        evs = [
+            dict(EV, entityId=f"g{e:02d}",
+                 properties={"seq": q},
+                 eventTime=f"2020-01-01T00:{q:02d}:{e % 60:02d}Z")
+            for q in range(per_entity) for e in range(n_entities)
+        ]
+        for b in range(0, total, 50):
+            r = sess.post(f"{s.url}/batch/events.json?accessKey={key}",
+                          json=evs[b:b + 50], timeout=30)
+            assert r.status_code == 200
+            assert all(x["status"] == 201 for x in r.json()), r.text[:300]
+
+        assert list(Storage.get_events().find(EventQuery(app.id))) == []
+        _poll(lambda: requests.get(
+            f"{s.url}/health.json").json()["status"] == "degraded",
+            what="degraded health during outage")
+        h = requests.get(f"{s.url}/health.json").json()
+        assert h["journal"]["lag"] == total
+        assert len(h["partitions"]) == 8
+        assert all(p["lag"] > 0 for p in h["partitions"])
+
+        # let exactly 3 drain batches through (drain_batch=128 >= any
+        # partition's lag, so one batch fully drains one partition),
+        # then the outage resumes: 3 of 8 cursors advanced, 5 pending
+        FAULTS.inject("eventserver.drain", "error", after=3)
+
+        def _three_drained():
+            st = requests.get(
+                f"{s.url}/stats.json?accessKey={key}").json()["ingest"]
+            return st["drain"]["drainedBatches"] == 3
+        _poll(_three_drained, what="exactly 3 partition batches to drain")
+
+        h = requests.get(f"{s.url}/health.json").json()
+        drained_parts = [p for p in h["partitions"] if p["lag"] == 0]
+        assert len(drained_parts) == 3
+        assert 0 < h["journal"]["lag"] < total
+
+        s.kill()  # cold crash mid-drain
+        killed = True
+    finally:
+        if not killed:
+            s.stop()
+
+    FAULTS.clear()  # storage recovers before the restart
+    s2 = _DurableServer(_fast_ingestor(wal, partitions=8, drain_batch=128))
+    try:
+        def _recovered():
+            h = requests.get(f"{s2.url}/health.json").json()
+            return h["status"] == "ok" and h["journal"]["lag"] == 0
+        _poll(_recovered, timeout=60, what="recovery to ok with zero lag")
+
+        got = list(Storage.get_events().find(EventQuery(app.id, limit=-1)))
+        assert len(got) == total  # exactly once, nothing lost
+        by_entity = {}
+        for e in got:
+            by_entity.setdefault(e.entity_id, []).append(e)
+        assert len(by_entity) == n_entities
+        for eid, entity_events in by_entity.items():
+            seqs = [e.properties["seq"] for e in sorted(
+                entity_events, key=lambda e: e.event_time)]
+            assert seqs == list(range(per_entity)), (eid, seqs)
+    finally:
+        s2.stop()
+
+
+@pytest.mark.chaos
+def test_poison_partition_browns_out_alone(tmp_path):
+    """One wedged partition must not stall the other N-1: its breaker
+    opens and /health.json degrades, but sibling partitions keep
+    draining to the backend the whole time."""
+    ents = _entities_by_partition(4, 3)
+    poison = 2
+    app, key = _mk_app_key()
+    FAULTS.inject(f"eventserver.drain_partition.p{poison}", "error")
+    s = _DurableServer(_fast_ingestor(tmp_path / "wal", partitions=4))
+    try:
+        for k in range(4):
+            for eid in ents[k]:
+                assert requests.post(
+                    f"{s.url}/events.json?accessKey={key}",
+                    json=dict(EV, entityId=eid)).status_code == 201
+
+        healthy_ids = {eid for k, v in ents.items() if k != poison
+                       for eid in v}
+        _poll(lambda: {e.entity_id for e in Storage.get_events().find(
+            EventQuery(app.id, limit=-1))} == healthy_ids,
+            what="healthy partitions to drain around the poison one")
+
+        def _poison_open():
+            h = requests.get(f"{s.url}/health.json").json()
+            return (h["status"] == "degraded"
+                    and h["partitions"][poison]["breakerState"] == "open")
+        _poll(_poison_open, what="poison partition breaker to open")
+        h = requests.get(f"{s.url}/health.json").json()
+        assert h["partitions"][poison]["lag"] == 3
+        for k in range(4):
+            if k != poison:
+                assert h["partitions"][k]["breakerState"] == "closed"
+                assert h["partitions"][k]["lag"] == 0
+
+        st = requests.get(
+            f"{s.url}/stats.json?accessKey={key}").json()["ingest"]
+        per = st["drain"]["partitions"]
+        assert per[poison]["breakerState"] == "open"
+        assert per[poison]["breakerOpens"] >= 1
+        assert st["drain"]["breakerState"] == "open"  # aggregate = worst
+        assert {d["partition"] for d in st["journal"]["perPartition"]} \
+            == set(range(4))
+
+        # per-partition observability rides the metrics registry too
+        from predictionio_tpu.obs.metrics import METRICS
+
+        text = METRICS.render_prometheus()
+        assert f'pio_journal_partition_lag{{partition="{poison}"}} 3' in text
+        assert 'pio_ingest_drain_failures_total{partition="%d"}' % poison \
+            in text
+
+        FAULTS.clear()  # the poison clears; the partition heals alone
+
+        def _healed():
+            h = requests.get(f"{s.url}/health.json").json()
+            return h["status"] == "ok" and h["journal"]["lag"] == 0
+        _poll(_healed, what="poison partition to heal")
+        got = {e.entity_id for e in Storage.get_events().find(
+            EventQuery(app.id, limit=-1))}
+        assert got == {eid for v in ents.values() for eid in v}
+    finally:
+        s.stop()
+
+
+def test_batch_full_partition_503s_only_its_events(tmp_path):
+    """A batch spanning partitions where ONE is at capacity: that
+    partition's events answer 503 (+Retry-After on the wrapper), the
+    siblings' events still ack 201 — per-partition backpressure at the
+    HTTP surface."""
+    import asyncio
+
+    ents = _entities_by_partition(2, 1)
+    hot, cold = ents[0][0], ents[1][0]
+    app, key = _mk_app_key()
+    # tiny cap: each partition takes ~2 small events, then JournalFull
+    ing = _fast_ingestor(tmp_path / "wal", partitions=2, max_bytes=1200,
+                         fsync="never")
+    FAULTS.inject("eventserver.drain", "error")  # keep records queued
+    s = _DurableServer(ing)
+    try:
+        # fill the hot partition via singles until it 503s
+        saw_503 = False
+        for i in range(40):
+            r = requests.post(f"{s.url}/events.json?accessKey={key}",
+                              json=dict(EV, entityId=hot))
+            if r.status_code == 503:
+                saw_503 = True
+                break
+        assert saw_503
+        # mixed batch: hot-partition events 503, cold-partition event 201
+        rb = requests.post(
+            f"{s.url}/batch/events.json?accessKey={key}",
+            json=[dict(EV, entityId=hot), dict(EV, entityId=cold),
+                  dict(EV, entityId=hot)])
+        assert rb.status_code == 200
+        assert float(rb.headers["Retry-After"]) > 0
+        assert [x["status"] for x in rb.json()] == [503, 201, 503]
+    finally:
+        FAULTS.clear()
+        s.stop()

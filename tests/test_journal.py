@@ -256,3 +256,179 @@ def test_fsync_fault_site(jdir):
     j.sync()  # the retry fsyncs the still-pending bytes
     assert j.stats()["unsyncedBytes"] == 0
     j.close()
+
+
+# ---------------------------------------------------------------------------
+# PartitionedJournal (ISSUE 9): N independent journals keyed by entity hash
+
+
+def _pj(jdir, n, **kw):
+    from predictionio_tpu.storage.journal import PartitionedJournal
+
+    kw.setdefault("fsync", "never")
+    return PartitionedJournal(jdir, partitions=n, **kw)
+
+
+def test_partitioned_layout_and_routing(jdir):
+    """Seed pin for the on-disk layout: N>1 puts each partition under
+    p<k>/ with its own segments + cursor, and stamps partitions.json;
+    routing is shard_of(entity_type, entity_id, N)."""
+    from predictionio_tpu.storage.partition import shard_of
+
+    j = _pj(jdir, 4)
+    assert (jdir / "partitions.json").exists()
+    for i in range(20):
+        part = j.partition_of("user", f"u{i}")
+        assert part == shard_of("user", f"u{i}", 4)
+        j.append(p(i), part)
+    assert j.lag == 20
+    assert sum(j.lag_of(k) for k in range(4)) == 20
+    touched = [k for k in range(4) if j.lag_of(k)]
+    assert len(touched) > 1  # the hash actually spreads entities
+    for k in touched:
+        assert list((jdir / f"p{k}").glob("journal-*.log"))
+    assert not list(jdir.glob("journal-*.log"))  # nothing at the root
+    # per-partition drain: each cursor is independent
+    k0 = touched[0]
+    records, pos = j.peek_batch(k0, 100)
+    assert len(records) == j.lag_of(k0)
+    j.advance(k0, pos)
+    assert j.lag_of(k0) == 0
+    assert j.lag == 20 - len(records)
+    j.close()
+
+
+def test_partitioned_n1_keeps_flat_legacy_layout(jdir):
+    """Seed pin: partitions=1 is byte-compatible with the pre-partition
+    journal — segments + cursor live at the directory root, no p0/."""
+    j = _pj(jdir, 1)
+    j.append(p(0), 0)
+    j.close()
+    assert list(jdir.glob("journal-*.log"))
+    assert not (jdir / "p0").exists()
+    # a journal written BEFORE partitioning existed (no marker) opens
+    # as one partition with its records intact
+    (jdir / "partitions.json").unlink()
+    j2 = _pj(jdir, 1)
+    assert j2.lag == 1
+    assert j2.peek_batch(0, 10)[0] == [p(0)]
+    j2.close()
+
+
+def test_partitioned_gc_isolation(jdir):
+    """Draining one partition GCs ITS segments only — a lagging sibling
+    keeps every file it still needs."""
+    j = _pj(jdir, 2, segment_max_bytes=64)
+    for i in range(12):
+        j.append(p(i), i % 2)
+    segs_before = {k: len(list((jdir / f"p{k}").glob("journal-*.log")))
+                   for k in (0, 1)}
+    assert min(segs_before.values()) > 1  # both rotated
+    records, pos = j.peek_batch(0, 100)
+    j.advance(0, pos)
+    assert j.lag_of(0) == 0 and j.lag_of(1) == 6
+    segs_after0 = len(list((jdir / "p0").glob("journal-*.log")))
+    segs_after1 = len(list((jdir / "p1").glob("journal-*.log")))
+    assert segs_after0 < segs_before[0]   # p0 collected
+    assert segs_after1 == segs_before[1]  # p1 untouched
+    assert j.peek_batch(1, 100)[0] == [p(i) for i in range(12) if i % 2]
+    j.close()
+
+
+def test_partitioned_torn_tail_isolated(jdir):
+    """A torn tail in one partition truncates THAT partition on reopen;
+    siblings replay every record untouched."""
+    j = _pj(jdir, 2)
+    for i in range(6):
+        j.append(p(i), i % 2)
+    j.close()
+    seg = sorted((jdir / "p1").glob("journal-*.log"))[-1]
+    with open(seg, "ab") as fh:
+        fh.write(b"\x40\x00\x00\x00\x99\x99torn")
+    j2 = _pj(jdir, 2)
+    assert j2.peek_batch(0, 100)[0] == [p(0), p(2), p(4)]
+    assert j2.peek_batch(1, 100)[0] == [p(1), p(3), p(5)]
+    st = j2.stats()
+    assert st["truncatedBytes"] > 0
+    per = {d["partition"]: d for d in st["perPartition"]}
+    assert per[1]["truncatedBytes"] > 0 and per[0]["truncatedBytes"] == 0
+    j2.close()
+
+
+def test_partitioned_full_is_per_partition(jdir):
+    """Capacity is split across partitions; a hot partition 503s alone
+    while its siblings keep accepting."""
+    j = _pj(jdir, 2, max_bytes=600, segment_max_bytes=300)
+    hot = 0
+    with pytest.raises(JournalFull):
+        for i in range(1000):
+            j.append(p(i), hot)
+    j.append(p(0), 1)  # the sibling still has its own headroom
+    assert j.fill_of(hot) > j.fill_of(1)
+    assert j.fill_fraction() == pytest.approx(j.fill_of(hot))
+    j.close()
+
+
+def test_partition_resize_requires_drained(jdir):
+    """N -> M with undrained records is refused; drained journals resize
+    cleanly and every partition starts empty (docs/operations.md
+    'Ingestion at scale')."""
+    from predictionio_tpu.storage.journal import JournalLayoutError
+
+    j = _pj(jdir, 2)
+    j.append(p(0), 0)
+    j.close()
+    with pytest.raises(JournalLayoutError, match="drained"):
+        _pj(jdir, 4)
+    # drain, then resize both ways
+    j = _pj(jdir, 2)
+    records, pos = j.peek_batch(0, 10)
+    j.advance(0, pos)
+    j.close()
+    j4 = _pj(jdir, 4)
+    assert j4.num_partitions == 4 and j4.lag == 0
+    j4.append(p(1), 3)
+    j4.close()
+    with pytest.raises(JournalLayoutError):
+        _pj(jdir, 1)  # shrink is guarded the same way
+    j4 = _pj(jdir, 4)
+    records, pos = j4.peek_batch(3, 10)
+    j4.advance(3, pos)
+    j4.close()
+    j1 = _pj(jdir, 1)
+    assert j1.num_partitions == 1 and j1.lag == 0
+    j1.close()
+
+
+@pytest.mark.chaos
+def test_partition_append_fault_site(jdir):
+    from predictionio_tpu.workflow.faults import FAULTS, FaultInjected
+
+    j = _pj(jdir, 2)
+    FAULTS.inject("journal.partition_append", "error", times=1)
+    with pytest.raises(FaultInjected):
+        j.append(p(0), 0)
+    assert j.lag == 0  # refused before any partition was touched
+    j.append(p(0), 0)
+    assert j.lag == 1
+    j.close()
+
+
+def test_partitioned_stats_and_metrics_labels(jdir):
+    """The per-partition gauges carry a partition label and the stats
+    aggregate keeps the single-journal key shape."""
+    from predictionio_tpu.obs.metrics import METRICS
+
+    j = _pj(jdir, 2)
+    j.append(p(0), 0)
+    j.append(p(1), 0)
+    j.append(p(2), 1)
+    st = j.stats()
+    assert st["lag"] == 3 and st["partitions"] == 2
+    assert {d["partition"] for d in st["perPartition"]} == {0, 1}
+    assert {d["lag"] for d in st["perPartition"]} == {1, 2}
+    text = METRICS.render_prometheus()
+    assert 'pio_journal_partition_lag{partition="0"} 2' in text
+    assert 'pio_journal_partition_lag{partition="1"} 1' in text
+    assert 'pio_journal_partition_fill{partition="0"}' in text
+    j.close()

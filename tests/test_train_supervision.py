@@ -671,5 +671,6 @@ def test_every_fault_site_documented_in_operations_md():
     for new_site in ("train.step", "train.persist",
                      "admission.decide", "loadgen.slow_device",
                      "checkpoint.shard_write", "checkpoint.manifest_commit",
-                     "train.host_lost"):
+                     "train.host_lost",
+                     "journal.partition_append", "eventserver.drain_partition"):
         assert new_site in sites
