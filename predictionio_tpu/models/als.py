@@ -159,6 +159,25 @@ class ALSModel(RetrievalServingMixin):
     item_ids: BiMap  # str -> row
     config: ALSConfig
 
+    def __setattr__(self, name, value):
+        # _vtv_cache/_cn_cache are derived from item_factors; replacing
+        # the factors (reload/restore paths) must drop them or fold-in
+        # keeps solving against the OLD VᵀV. In-place mutation
+        # (item_factors[:] = ...) bypasses this — call
+        # invalidate_item_caches() explicitly there.
+        super().__setattr__(name, value)
+        if name == "item_factors":
+            self.__dict__.pop("_vtv_cache", None)
+            self.__dict__.pop("_cn_cache", None)
+
+    def invalidate_item_caches(self) -> None:
+        """Drop every cache derived from ``item_factors`` (the implicit
+        VᵀV term and the normalized catalog). Assigning a new
+        ``item_factors`` array does this automatically; call this after
+        mutating the array IN PLACE."""
+        self.__dict__.pop("_vtv_cache", None)
+        self.__dict__.pop("_cn_cache", None)
+
     # -- serving-side scoring (CreateServer hot path) ----------------------
     def scores_for_user(self, user_id: str) -> np.ndarray | None:
         row = self.user_ids.get(user_id)
@@ -209,38 +228,126 @@ class ALSModel(RetrievalServingMixin):
         ratings, or implicit confidence inputs); defaults to 1.0 each.
         Unknown item ids are skipped; returns None if none are known.
         """
-        rows, kept = [], []
-        for j, iid in enumerate(item_ids):
-            row = self.item_ids.get(iid)
-            if row is not None:
-                rows.append(row)
-                kept.append(j)
-        if not rows:
+        prep = self._fold_in_prep(item_ids, ratings)
+        if prep is None:
             return None
-        v_s = self.item_factors[rows].astype(np.float64)  # [k, R]
+        a, b = self._fold_in_equations(*prep)
+        return np.linalg.solve(a, b).astype(np.float32)
+
+    def _fold_in_lookup(self, item_ids) -> tuple[np.ndarray, np.ndarray]:
+        """One vectorized id→row pass (``BiMap.map_array``) — shared by
+        the single and batched fold-in paths. Returns ``(rows, kept)``:
+        ``kept`` is the boolean keep-mask over ``item_ids`` and ``rows``
+        the factor rows of the kept (known) ids."""
+        idx = self.item_ids.map_array(list(item_ids), default=-1)
+        kept = idx >= 0
+        return idx[kept], kept
+
+    def _fold_in_prep(self, item_ids, ratings):
+        """(rows, r) of the known items in float64, or None when no item
+        is known — the normal-equation inputs of one user's fold-in."""
+        rows, kept = self._fold_in_lookup(item_ids)
+        if rows.size == 0:
+            return None
         if ratings is None:
-            r = np.ones(len(rows))
+            r = np.ones(rows.size, np.float64)
         else:
-            r = np.asarray([float(ratings[j]) for j in kept], np.float64)
+            r = np.asarray([float(x) for x in ratings], np.float64)[kept]
+        return rows, r
+
+    def _vtv(self) -> np.ndarray:
+        """The implicit-mode VᵀV term, cached (depends only on the item
+        factors; dropped by ``invalidate_item_caches`` / item-factor
+        replacement, and stripped from MODELDATA blobs by the mixin
+        ``__getstate__``)."""
+        vtv = getattr(self, "_vtv_cache", None)
+        if vtv is None:
+            v_all = self.item_factors.astype(np.float64)
+            vtv = v_all.T @ v_all
+            self._vtv_cache = vtv
+        return vtv
+
+    def _fold_in_equations(self, rows: np.ndarray, r: np.ndarray):
+        """One user's regularized normal equations (a, b) in float64 —
+        the exact system ``fold_in_user`` has always solved, factored
+        out so the batched kernel stacks the IDENTICAL matrices."""
+        v_s = self.item_factors[rows].astype(np.float64)  # [k, R]
         lam = self.config.lambda_
         rank = v_s.shape[1]
         eye = np.eye(rank)
         if self.config.implicit_prefs:
             alpha = self.config.alpha
-            vtv = getattr(self, "_vtv_cache", None)
-            if vtv is None:
-                # depends only on the (immutable-after-training) factors:
-                # computed once, never per query. Stripped from MODELDATA
-                # blobs by the mixin __getstate__.
-                v_all = self.item_factors.astype(np.float64)
-                vtv = v_all.T @ v_all
-                self._vtv_cache = vtv
-            a = vtv + (v_s * (alpha * r)[:, None]).T @ v_s + lam * eye
+            a = self._vtv() + (v_s * (alpha * r)[:, None]).T @ v_s + lam * eye
             b = ((1.0 + alpha * r)[:, None] * v_s).sum(axis=0)
         else:
             a = v_s.T @ v_s + lam * max(len(rows), 1) * eye
             b = (r[:, None] * v_s).sum(axis=0)
-        return np.linalg.solve(a, b).astype(np.float32)
+        return a, b
+
+    def fold_in_users(self, batch, solver: str = "host"):
+        """Batched fold-in: ``batch = [(item_ids, ratings|None), ...]``
+        over B users in one call (the streaming updater's kernel —
+        ISSUE 10). Returns ``(factors, kept_users)``: ``kept_users`` is
+        a boolean [B] mask of users with at least one known item;
+        ``factors`` is ``[kept_users.sum(), R]`` float32, rows aligned
+        with the surviving users in order.
+
+        ``solver="host"`` (default): per-user float64 normal equations
+        stacked into ONE batched LAPACK solve — bitwise identical to B
+        independent ``fold_in_user`` calls (the gufunc loops the same
+        dgesv over each matrix), so this is the publish/reference path.
+        ``solver="device"``: one jitted dispatch — padded [B, D] gather
+        → ``_gram_blocks`` → batched Cholesky (``_spd_solve``) in f32,
+        for refreshing hundreds of users per dispatch; matches host to
+        f32 tolerance, not bitwise.
+        """
+        prep: list = []
+        kept_users = np.zeros(len(batch), bool)
+        for u, (iids, ratings) in enumerate(batch):
+            p = self._fold_in_prep(iids, ratings)
+            if p is None:
+                continue
+            kept_users[u] = True
+            prep.append(p)
+        rank = self.config.rank
+        if not prep:
+            return np.zeros((0, rank), np.float32), kept_users
+        if solver == "device":
+            return self._fold_in_users_device(prep), kept_users
+        nb = len(prep)
+        a = np.empty((nb, rank, rank), np.float64)
+        b = np.empty((nb, rank), np.float64)
+        for i, (rows, r) in enumerate(prep):
+            a[i], b[i] = self._fold_in_equations(rows, r)
+        x = np.linalg.solve(a, b[..., None]).squeeze(-1)
+        return x.astype(np.float32), kept_users
+
+    def _fold_in_users_device(self, prep) -> np.ndarray:
+        """The jitted one-dispatch path: pad each user's (rows, vals) to
+        a shared power-of-two depth D (padded slots: id 0 / val 0 — the
+        ``_gram_blocks`` masked convention), gather + Gram + batched
+        Cholesky compiled once per (B_pad, D, rank, mode) shape."""
+        import jax.numpy as jnp
+
+        cfg = self.config
+        depth = max(int(rows.size) for rows, _ in prep)
+        d_pad = 1 << max(3, (depth - 1).bit_length())
+        b_pad = 1 << max(0, (len(prep) - 1).bit_length())
+        ids = np.zeros((b_pad, d_pad), np.int32)
+        vals = np.zeros((b_pad, d_pad), np.float32)
+        for i, (rows, r) in enumerate(prep):
+            ids[i, :rows.size] = rows
+            # a genuine 0.0 rating must stay a VALID slot: vals==0 is
+            # the padding mask, so nudge it (the layout builder's own
+            # convention, ops/neighbors.py)
+            vf = r.astype(np.float32)
+            vf[vf == 0.0] = 1e-30
+            vals[i, :rows.size] = vf
+        run = _fold_in_program(cfg.rank, cfg.implicit_prefs,
+                               float(cfg.alpha), float(cfg.lambda_))
+        x = run(jnp.asarray(ids), jnp.asarray(vals),
+                jnp.asarray(self.item_factors))
+        return np.asarray(x)[:len(prep)].astype(np.float32)
 
     def similar_items(self, item_rows: list[int], num: int,
                       candidate_mask: np.ndarray | None = None) -> list[tuple[int, float]]:
@@ -554,6 +661,36 @@ def _ridge(other_c, n, *, lambda_, implicit):
                           preferred_element_type=jnp.float32)  # VᵀV
         return lambda_, gram
     return lambda_ * jnp.maximum(n, 1.0), None
+
+
+def _fold_in_program(rank: int, implicit: bool, alpha: float, lambda_: float):
+    """Jitted batched fold-in: [B, D] gathered events → _gram_blocks →
+    regularized batched Cholesky. One compiled program per (rank, mode)
+    pair; jit's own cache handles the padded (B, D) shapes. Exact
+    factorization, not CG — fold-in has no next half-step to absorb an
+    inexact inner solve."""
+    key = (rank, implicit, alpha, lambda_)
+    prog = _FOLD_IN_PROGRAMS.get(key)
+    if prog is not None:
+        return prog
+    import jax
+
+    def run(ids, vals, item_factors):
+        a, b, n = _gram_blocks(ids[None], vals[None], item_factors,
+                               implicit=implicit, alpha=alpha, rank=rank,
+                               masked=True)
+        nb = ids.shape[0]
+        shift, gram = _ridge(item_factors, n.reshape(-1), lambda_=lambda_,
+                             implicit=implicit)
+        return _spd_solve(a.reshape(nb, rank, rank), b.reshape(nb, rank),
+                          solver="cholesky", shift=shift, gram=gram)
+
+    prog = jax.jit(run)
+    _FOLD_IN_PROGRAMS[key] = prog
+    return prog
+
+
+_FOLD_IN_PROGRAMS: dict = {}
 
 
 def _half_step(ids, vals, other, *, lambda_, implicit, alpha, rank,

@@ -97,8 +97,8 @@ _M_PART_FILL = METRICS.gauge(
 
 log = logging.getLogger("predictionio_tpu.journal")
 
-__all__ = ["EventJournal", "PartitionedJournal", "JournalFull",
-           "JournalLayoutError", "FSYNC_POLICIES"]
+__all__ = ["EventJournal", "PartitionedJournal", "JournalFollower",
+           "JournalFull", "JournalLayoutError", "FSYNC_POLICIES"]
 
 _HEADER = struct.Struct("<II")  # (payload length, crc32(payload))
 _SEGMENT_GLOB = "journal-*.log"
@@ -120,6 +120,32 @@ class JournalLayoutError(RuntimeError):
     the drainers reach lag 0, restart with the new count) — re-hashing
     undrained records across a different N would break per-entity
     ordering and exactly-once replay."""
+
+
+def _layout_of(directory: Path) -> int | None:
+    """Partition count of whatever lives in ``directory``: the stamped
+    ``partitions.json`` marker if readable, else inferred from the files
+    (p<k>/ subdirs, or flat pre-partitioning segments -> 1). Shared by
+    the writer (``PartitionedJournal``) and read-only followers."""
+    try:
+        n = int(json.loads(
+            (directory / _PARTITIONS_FILE).read_text())["partitions"])
+        if n >= 1:
+            return n
+    except FileNotFoundError:
+        pass
+    except (json.JSONDecodeError, ValueError, KeyError, TypeError,
+            OSError) as e:
+        log.warning("journal: unreadable %s (%s); inferring layout "
+                    "from files", _PARTITIONS_FILE, e)
+    pdirs = [d for d in directory.glob("p*")
+             if d.is_dir() and d.name[1:].isdigit()]
+    if pdirs:
+        return max(int(d.name[1:]) for d in pdirs) + 1
+    if any(directory.glob(_SEGMENT_GLOB)) \
+            or (directory / _CURSOR_FILE).exists():
+        return 1
+    return None
 
 
 def _segment_name(seq: int) -> str:
@@ -559,25 +585,7 @@ class PartitionedJournal:
         """Partition count of whatever already lives in ``dir``: the
         stamped marker if readable, else inferred from the files (p<k>/
         subdirs, or flat pre-partitioning segments -> 1)."""
-        try:
-            n = int(json.loads(
-                (self.dir / _PARTITIONS_FILE).read_text())["partitions"])
-            if n >= 1:
-                return n
-        except FileNotFoundError:
-            pass
-        except (json.JSONDecodeError, ValueError, KeyError, TypeError,
-                OSError) as e:
-            log.warning("journal: unreadable %s (%s); inferring layout "
-                        "from files", _PARTITIONS_FILE, e)
-        pdirs = [d for d in self.dir.glob("p*")
-                 if d.is_dir() and d.name[1:].isdigit()]
-        if pdirs:
-            return max(int(d.name[1:]) for d in pdirs) + 1
-        if any(self.dir.glob(_SEGMENT_GLOB)) \
-                or (self.dir / _CURSOR_FILE).exists():
-            return 1
-        return None
+        return _layout_of(self.dir)
 
     def _resize_from(self, prior: int) -> None:
         """Refuse unless every old partition is drained, then clear the
@@ -711,3 +719,167 @@ class PartitionedJournal:
     def close(self) -> None:
         for part in self._parts:
             part.close()
+
+
+class JournalFollower:
+    """Read-only tail of a (possibly partitioned) journal directory
+    behind an INDEPENDENT persisted follow cursor per partition — the
+    streaming updater's view of the WAL (ISSUE 10; the Kafka
+    consumer-group analog: one log, many cursors).
+
+    Strictly an observer of the drainer's journal: never touches
+    ``cursor.json``, never opens a write handle, never truncates or
+    GCs. Its own progress persists as ``follow-<name>.json`` beside each
+    partition's drain cursor (same ``{"seq", "off", "idx"}`` shape, same
+    atomic tmp + ``os.replace`` discipline).
+
+    Races it must absorb:
+
+    - **GC behind the drainer** can collect a segment the follower has
+      not finished: when the cursored segment is gone, clamp to the
+      oldest surviving one (the writer's own ``_recover`` rule).
+      Re-reading is safe — the consumer (fold-in) is a deterministic
+      per-user recomputation, so replay is idempotent.
+    - **A frame mid-write** (or a torn tail before writer recovery)
+      scans as invalid: the follower stops AT it without advancing and
+      retries next poll — the writer's next flush or its restart-time
+      truncation resolves it.
+    """
+
+    def __init__(self, directory: str | os.PathLike, *,
+                 name: str = "stream", partitions: int | None = None):
+        self.dir = Path(directory)
+        self.name = name
+        if partitions is not None:
+            n = int(partitions)
+            if n < 1:
+                raise ValueError(f"partitions must be >= 1, got {n}")
+        else:
+            n = _layout_of(self.dir) or 1
+        self.num_partitions = n
+        self._pos: dict[int, tuple[int, int, int]] = {
+            k: self._load_follow(k) for k in range(n)}
+
+    # -- layout / cursor ---------------------------------------------------
+    def _partition_dir(self, k: int) -> Path:
+        return self.dir if self.num_partitions == 1 else self.dir / f"p{k}"
+
+    def _cursor_path(self, k: int) -> Path:
+        return self._partition_dir(k) / f"follow-{self.name}.json"
+
+    def _load_follow(self, k: int) -> tuple[int, int, int]:
+        try:
+            c = json.loads(self._cursor_path(k).read_text())
+            return int(c["seq"]), int(c["off"]), int(c["idx"])
+        except FileNotFoundError:
+            return (0, 0, 0)  # oldest surviving record (clamped in poll)
+        except (json.JSONDecodeError, ValueError, KeyError, TypeError,
+                OSError) as e:
+            log.warning("journal: unreadable follow cursor %s (%s); "
+                        "replaying from the oldest record",
+                        self._cursor_path(k).name, e)
+            return (0, 0, 0)
+
+    def position(self, partition: int) -> tuple[int, int, int]:
+        return self._pos[partition]
+
+    def commit(self, partition: int, pos: tuple[int, int, int]) -> None:
+        """Persist the follow cursor — call only once the batch's effect
+        is settled downstream (published, or deliberately skipped); a
+        transient failure must NOT commit, so a restart replays."""
+        self._pos[partition] = (int(pos[0]), int(pos[1]), int(pos[2]))
+        path = self._cursor_path(partition)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = path.with_suffix(".tmp")
+        with open(tmp, "w") as fh:
+            fh.write(json.dumps({"seq": pos[0], "off": pos[1],
+                                 "idx": pos[2]}))
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+
+    # -- read path ---------------------------------------------------------
+    def _segments_on_disk(self, partition: int) -> dict[int, Path]:
+        d = self._partition_dir(partition)
+        return {_segment_seq(p): p for p in d.glob(_SEGMENT_GLOB)}
+
+    def poll(self, partition: int, max_records: int = 256,
+             ) -> tuple[list[bytes], tuple[int, int, int]]:
+        """Up to ``max_records`` payloads at/after the follow cursor, in
+        append order, plus the position to ``commit`` once they are
+        processed. Does not move the cursor."""
+        seq, off, idx = self._pos[partition]
+        known = self._segments_on_disk(partition)
+        out: list[bytes] = []
+        if not known:
+            return out, (seq, off, idx)
+        if seq not in known:
+            # cursored segment collected (or cursor from another life):
+            # clamp to the oldest surviving record — the _recover rule
+            seq, off = min(known), 0
+        while len(out) < max_records:
+            path = known.get(seq)
+            exhausted = path is None  # GC'd under us mid-poll: skip ahead
+            if path is not None:
+                hit_invalid = False
+                try:
+                    size = path.stat().st_size
+                    with open(path, "rb") as fh:
+                        fh.seek(off)
+                        while len(out) < max_records:
+                            header = fh.read(_HEADER.size)
+                            if len(header) < _HEADER.size:
+                                break
+                            length, crc = _HEADER.unpack(header)
+                            payload = fh.read(length)
+                            if len(payload) < length \
+                                    or zlib.crc32(payload) != crc:
+                                hit_invalid = True
+                                break
+                            out.append(payload)
+                            off += _HEADER.size + length
+                except OSError:
+                    exhausted = True
+                if not exhausted:
+                    if hit_invalid or len(out) >= max_records:
+                        break  # hold position; retry next poll
+                    if off < size:
+                        break  # partial frame at the active tail: wait
+                    exhausted = True  # consumed to its valid end
+            if exhausted:
+                nxt = min((s for s in known if s > seq), default=None)
+                if nxt is None:
+                    break
+                seq, off = nxt, 0
+        return out, (seq, off, idx + len(out))
+
+    def lag(self, partition: int) -> int:
+        """Records on disk at/after the follow cursor — the per-partition
+        tail-lag gauge (``pio_stream_tail_lag``)."""
+        seq, off, _ = self._pos[partition]
+        known = self._segments_on_disk(partition)
+        if known and seq not in known:
+            seq, off = min(known), 0
+        n = 0
+        for s in sorted(known):
+            if s < seq:
+                continue
+            path = known[s]
+            try:
+                size = path.stat().st_size
+                with open(path, "rb") as fh:
+                    pos = off if s == seq else 0
+                    fh.seek(pos)
+                    while True:
+                        header = fh.read(_HEADER.size)
+                        if len(header) < _HEADER.size:
+                            break
+                        length, _crc = _HEADER.unpack(header)
+                        pos += _HEADER.size + length
+                        if pos > size:
+                            break
+                        fh.seek(length, os.SEEK_CUR)
+                        n += 1
+            except OSError:
+                continue
+        return n

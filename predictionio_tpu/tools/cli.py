@@ -646,6 +646,50 @@ def cmd_undeploy(args) -> int:
 # servers / status / import / export
 # ---------------------------------------------------------------------------
 
+def cmd_stream(args) -> int:
+    """Streaming online learning (ISSUE 10): tail the event server's
+    write-ahead journal behind an independent follow cursor, fold each
+    batch of events into user factors with the batched fold-in kernel,
+    and hot-patch the deployed engine server via POST /reload/delta —
+    cold-start users personalized within one batch window, no retrain."""
+    _enable_compile_cache()
+    from ..workflow import Context, prepare_deploy
+    from ..workflow.streaming import StreamingUpdater
+
+    engine_dir, engine, inst = _resolve_engine_instance(args)
+    result = prepare_deploy(engine, inst, Context(mode="Serving"),
+                            engine_dir=engine_dir)
+    model = next((m for m in result.models
+                  if hasattr(m, "fold_in_users")), None)
+    if model is None:
+        _die("no trained model supports fold-in (fold_in_users); "
+             "streaming updates need a factorization model (ALS)")
+    updater = StreamingUpdater(
+        model,
+        args.journal_dir,
+        args.engine_url,
+        name=args.follow_name,
+        partitions=args.journal_partitions or None,
+        batch_window_ms=args.batch_window_ms,
+        max_records=args.max_records,
+        eval_gate=args.eval_gate,
+        eval_k=args.eval_k,
+        solver=args.fold_in_solver,
+        breaker_threshold=args.breaker_threshold,
+        breaker_reset_s=args.breaker_reset_s,
+    )
+    _ok(f"Streaming updater: journal {args.journal_dir} -> "
+        f"{args.engine_url} (model instance {inst.id}, gate "
+        f"{args.eval_gate if args.eval_gate is not None else 'off'}). "
+        f"Ctrl-C to stop.")
+    try:
+        updater.run_forever()
+    except KeyboardInterrupt:
+        updater.stop()
+    _ok(f"Streaming updater stopped: {json.dumps(updater.stats())}")
+    return 0
+
+
 def cmd_eventserver(args) -> int:
     from ..api import run_event_server
 
@@ -1067,6 +1111,56 @@ def build_parser() -> argparse.ArgumentParser:
                     help="token-bucket burst headroom "
                          "(0 = 2x --rate-limit-qps)")
 
+    sp = sub.add_parser("stream",
+                        help="streaming online learning: tail the event "
+                             "server's journal, fold events into user "
+                             "factors, hot-patch the deployed engine "
+                             "server (POST /reload/delta)")
+    _add_engine_args(sp)
+    sp.add_argument("--journal-dir", required=True,
+                    help="the event server's write-ahead journal "
+                         "directory to tail (read-only; an independent "
+                         "follow cursor per partition, never the "
+                         "drainer's cursor.json)")
+    sp.add_argument("--engine-url", default="http://localhost:8000",
+                    help="deployed engine server to hot-patch "
+                         "(default http://localhost:8000)")
+    sp.add_argument("--engine-instance-id",
+                    help="fold in against this trained instance instead "
+                         "of the latest COMPLETED one")
+    sp.add_argument("--batch-window-ms", type=float, default=500.0,
+                    help="poll/fold cadence: events are accumulated per "
+                         "user and folded in one batched solve per "
+                         "window (default 500)")
+    sp.add_argument("--eval-gate", type=float, default=None,
+                    help="eval-gated promotion: leave-one-out hit@k on "
+                         "each batch's holdout slice; skip publishing "
+                         "when the batch metric regresses more than this "
+                         "below the current serving baseline (default: "
+                         "gate off)")
+    sp.add_argument("--eval-k", type=int, default=10,
+                    help="k for the gate's holdout hit@k (default 10)")
+    sp.add_argument("--journal-partitions", type=int, default=0,
+                    help="journal partition count; 0 infers it from the "
+                         "journal's partitions.json marker (default 0)")
+    sp.add_argument("--follow-name", default="stream",
+                    help="follow-cursor family name (follow-<name>.json); "
+                         "distinct names = independent consumers")
+    sp.add_argument("--max-records", type=int, default=1024,
+                    help="max journal records per partition per cycle")
+    sp.add_argument("--fold-in-solver", choices=["host", "device"],
+                    default="host",
+                    help="'host' publishes float64-solved factors that "
+                         "bitwise-match the fold_in_user reference; "
+                         "'device' dispatches the jitted batched "
+                         "Cholesky kernel (f32)")
+    sp.add_argument("--breaker-threshold", type=int, default=5,
+                    help="consecutive publish failures that open the "
+                         "delta-publish circuit breaker (default 5)")
+    sp.add_argument("--breaker-reset-s", type=float, default=5.0,
+                    help="seconds between half-open probes while the "
+                         "publish breaker is open (default 5)")
+
     sp = sub.add_parser("adminserver")
     sp.add_argument("--ip", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=7071)
@@ -1129,6 +1223,7 @@ COMMANDS = {
     "bench": cmd_bench,
     "undeploy": cmd_undeploy,
     "eventserver": cmd_eventserver,
+    "stream": cmd_stream,
     "adminserver": cmd_adminserver,
     "dashboard": cmd_dashboard,
     "status": cmd_status,

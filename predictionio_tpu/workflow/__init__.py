@@ -17,6 +17,7 @@ from .serialization import (
     deserialize_models,
     serialize_models,
 )
+from .streaming import StreamingUpdater
 from .supervisor import (
     TrainBudgetExceeded,
     TrainSupervisor,
@@ -27,7 +28,8 @@ from .supervisor import (
 
 __all__ = [
     "Context", "ModelIntegrityError", "PersistentModelManifest",
-    "RetrainMarker", "TrainBudgetExceeded", "TrainCheckpointer",
+    "RetrainMarker", "StreamingUpdater",
+    "TrainBudgetExceeded", "TrainCheckpointer",
     "TrainSupervisor", "TransientTrainingError", "WorkflowParams",
     "classify_error",
     "deserialize_models", "engine_params_from_instance", "prepare_deploy",

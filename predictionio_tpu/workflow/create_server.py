@@ -54,6 +54,7 @@ import uuid
 from datetime import datetime, timezone
 from typing import Any
 
+import numpy as np
 from aiohttp import web
 
 from ..controller.engine import Engine, TrainResult
@@ -101,6 +102,18 @@ _M_MODE = METRICS.gauge(
 _M_DEADLINE = METRICS.counter(
     "pio_deadline_expired_total",
     "queries answered 504 because their end-to-end deadline expired")
+# ISSUE 10: delta hot-patch surface (POST /reload/delta) — per-request
+# outcome counter plus the monotonic patch epoch, so the streaming
+# updater's view (pio_stream_patch_epoch) can be joined against the
+# server's own idea of what it applied
+_M_DELTA = METRICS.counter(
+    "pio_delta_patch_total",
+    "POST /reload/delta requests by outcome (ok/empty/bad_request/error)",
+    labelnames=("status",))
+_M_DELTA_EPOCH = METRICS.gauge(
+    "pio_delta_patch_epoch",
+    "monotonic serving-bundle patch epoch (bumps per applied delta batch "
+    "and per full-reload reconciliation)")
 
 
 def _to_jsonable(x: Any) -> Any:
@@ -264,6 +277,7 @@ class EngineServer:
         rate_limit_burst: float = 0.0,
         brownout_topk: int = 10,
         retrieval: dict | None = None,
+        patch_table_max: int = 100_000,
     ):
         self.engine = engine
         self.ctx = ctx or Context(mode="Serving")
@@ -302,6 +316,16 @@ class EngineServer:
         # a single actor, CreateServer.scala:552-559)
         self._stats_lock = threading.Lock()
         self._reload_lock = threading.Lock()  # serialize expensive reloads
+        # ISSUE 10: delta hot-patch state (POST /reload/delta). The patch
+        # table records every user-factor delta applied since the last
+        # full reload, so reconciliation can tell superseded deltas (the
+        # fresh instance trained the user) from ones that must carry over
+        # (user still unseen by training). Bounded: a runaway updater
+        # must not grow the serving bundle without limit.
+        self.patch_epoch = 0
+        self.patch_table: dict[str, np.ndarray] = {}
+        self.patch_table_max = max(1, patch_table_max)
+        self.patch_discarded = 0  # lifetime deltas superseded by reloads
         # resilience state: deadlines, degraded mode, drain
         self.deadline_ms = max(0.0, deadline_ms)
         self.dispatch_timeout_s = (dispatch_timeout_s
@@ -579,6 +603,8 @@ class EngineServer:
                 "engineInstanceId": inst.id,
                 "fallbackActive": bool(self.deploy_skips),
                 "skipped": self.deploy_skips,
+                "patchEpoch": self.patch_epoch,
+                "patchedUsers": len(self.patch_table),
             },
             "feedback": self.feedback.stats() if self.feedback else None,
         }
@@ -730,10 +756,143 @@ class EngineServer:
                          # /reload preserves the ANN configuration (and
                          # rebuilds the index over the fresh factors)
                          retrieval=self.deployed.retrieval)
+        # ISSUE 10: reconcile outstanding delta patches before the swap.
+        # Deltas for users the fresh instance trained are superseded
+        # (training saw their journaled events) and are discarded; deltas
+        # for users STILL unseen by training re-apply onto the fresh
+        # bundle so a reload never un-personalizes a folded-in user.
+        if self.patch_table:
+            keep = {u: f for u, f in self.patch_table.items()
+                    if not any(u in getattr(m, "user_ids", ())
+                               for m in fresh.result.models)}
+            discarded = len(self.patch_table) - len(keep)
+            if keep:
+                models, applied = self._patch_models(fresh.result.models, keep)
+                fresh.result = dataclasses.replace(fresh.result, models=models)
+                keep = {u: keep[u] for u in applied}
+            self.patch_table = keep
+            self.patch_discarded += discarded
+            self.patch_epoch += 1
+            _M_DELTA_EPOCH.set(self.patch_epoch)
+            log.info("reload reconciled delta patches: %d discarded as "
+                     "superseded, %d re-applied", discarded, len(keep))
         self.deployed = fresh  # atomic reference swap
         self.deploy_skips = skips
         log.info("Reloaded engine instance %s", fresh_inst.id)
         return fresh_inst.id
+
+    # -- delta hot-patch (ISSUE 10: streaming fold-in publish target) ------
+    @staticmethod
+    def _patch_models(models, patches: dict) -> tuple[list, set]:
+        """Apply ``{user_id: factor}`` to every model carrying user-side
+        factors whose rank matches. Copy-on-write: patched models are
+        shallow clones with fresh ``user_factors`` (and an extended
+        ``user_ids`` map for users unseen at train time); attached
+        item-side retrievers carry over untouched — item factors never
+        change here, so the ANN index and compiled retrieval programs
+        stay valid. Returns ``(new_models, applied_user_ids)``."""
+        new_models = list(models)
+        applied: set = set()
+        for mi, model in enumerate(models):
+            ids = getattr(model, "user_ids", None)
+            uf = getattr(model, "user_factors", None)
+            if ids is None or uf is None or getattr(uf, "ndim", 0) != 2:
+                continue
+            rank = uf.shape[1]
+            updates: dict[int, np.ndarray] = {}
+            appends: list[tuple[str, np.ndarray]] = []
+            for uid, vec in patches.items():
+                if vec.shape != (rank,):
+                    continue
+                row = ids.get(uid)
+                if row is None:
+                    appends.append((uid, vec))
+                else:
+                    updates[int(row)] = vec
+            if not updates and not appends:
+                continue
+            # NOT copy.copy: the serving mixin's __getstate__ strips the
+            # attached retriever from pickles, and copy() rides that —
+            # a delta patch must never silently de-attach the retriever
+            clone = object.__new__(type(model))
+            clone.__dict__.update(model.__dict__)
+            factors = np.array(uf, dtype=uf.dtype)
+            for row, vec in updates.items():
+                factors[row] = vec.astype(factors.dtype)
+            if appends:
+                mapping = ids.to_dict()
+                base = factors.shape[0]
+                for j, (uid, vec) in enumerate(appends):
+                    mapping[uid] = base + j
+                factors = np.vstack(
+                    [factors] + [v[None, :].astype(factors.dtype)
+                                 for _, v in appends])
+                clone.user_ids = type(ids)(mapping)
+            clone.user_factors = factors
+            new_models[mi] = clone
+            applied.update(u for u, _ in appends)
+            applied.update(u for u, v in patches.items()
+                           if v.shape == (rank,) and ids.get(u) is not None)
+        return new_models, applied
+
+    def apply_delta(self, patches: dict) -> dict:
+        """POST /reload/delta body ``users``: ``{user_id: [factor]}``.
+        Validates, bounds the patch table, swaps a copy-on-write bundle
+        under the reload lock, bumps the monotonic patch epoch."""
+        with self._reload_lock:
+            return self._apply_delta(patches)
+
+    def _apply_delta(self, patches: dict) -> dict:
+        clean: dict[str, np.ndarray] = {}
+        invalid: list[str] = []
+        for uid, vec in patches.items():
+            uid = str(uid)
+            try:
+                arr = np.asarray(vec, dtype=np.float32)
+            except (TypeError, ValueError):
+                invalid.append(uid)
+                continue
+            if arr.ndim != 1 or arr.size == 0 or not np.all(np.isfinite(arr)):
+                invalid.append(uid)
+                continue
+            clean[uid] = arr
+        # rank-check BEFORE bounding: a vector no model can absorb must
+        # not consume a table slot that a valid user would have kept
+        bundle = self.deployed
+        ranks = {m.user_factors.shape[1] for m in bundle.result.models
+                 if getattr(m, "user_ids", None) is not None
+                 and getattr(getattr(m, "user_factors", None),
+                             "ndim", 0) == 2}
+        rank_mismatch = sorted(u for u, v in clean.items()
+                               if v.size not in ranks)
+        for u in rank_mismatch:
+            clean.pop(u)
+        # bounded patch table: users already tracked always re-patch;
+        # NEW users only while there is room (deterministic drop order)
+        room = self.patch_table_max - len(self.patch_table)
+        fresh_users = sorted(u for u in clean if u not in self.patch_table)
+        table_full = fresh_users[max(0, room):]
+        for u in table_full:
+            clean.pop(u)
+        new_models, applied = self._patch_models(bundle.result.models, clean)
+        if applied:
+            fresh = object.__new__(Deployed)
+            fresh.__dict__.update(bundle.__dict__)
+            fresh.result = dataclasses.replace(bundle.result,
+                                               models=new_models)
+            self.deployed = fresh  # atomic reference swap
+            self.patch_epoch += 1
+            _M_DELTA_EPOCH.set(self.patch_epoch)
+            for u in applied:
+                self.patch_table[u] = clean[u]
+        return {
+            "appliedCount": len(applied),
+            "applied": sorted(applied),
+            "epoch": self.patch_epoch,
+            "patchedUsers": len(self.patch_table),
+            "dropped": {"invalid": invalid, "tableFull": table_full,
+                        "rankMismatch": rank_mismatch},
+        }
 
     def status(self) -> dict:
         inst = self.deployed.instance
@@ -816,6 +975,13 @@ class EngineServer:
                 "engineInstanceId": self.deployed.instance.id,
                 "fallbackActive": bool(self.deploy_skips),
                 "skipped": self.deploy_skips,
+            },
+            # ISSUE 10: streaming delta hot-patch posture
+            "patches": {
+                "epoch": self.patch_epoch,
+                "patchedUsers": len(self.patch_table),
+                "tableMax": self.patch_table_max,
+                "discardedByReload": self.patch_discarded,
             },
             "feedback": self.feedback.stats() if self.feedback else None,
         }
@@ -944,6 +1110,45 @@ async def handle_reload(request: web.Request) -> web.Response:
     return web.json_response({"message": "Reloaded", "engineInstanceId": iid})
 
 
+async def handle_reload_delta(request: web.Request) -> web.Response:
+    """POST /reload/delta — the streaming updater's publish target
+    (ISSUE 10): ``{"users": {user_id: [factor]}}`` hot-patches user-side
+    factors copy-on-write under the reload lock. Item factors are never
+    touched, so the ANN index and compiled retrieval programs stay
+    valid; unseen users are appended (bounded by the patch table)."""
+    server: EngineServer = request.app[SERVER_KEY]
+    rid = ensure_request_id(request.headers.get(TRACE_HEADER))
+    headers = {TRACE_HEADER: rid}
+    if server.draining:
+        _M_DELTA.inc(status="draining")
+        return web.json_response(
+            {"message": "Server is draining; not accepting patches."},
+            status=503, headers=headers)
+    try:
+        body = await request.json()
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        _M_DELTA.inc(status="bad_request")
+        return web.json_response({"message": "Malformed JSON body."},
+                                 status=400, headers=headers)
+    users = body.get("users") if isinstance(body, dict) else None
+    if not isinstance(users, dict) or not users:
+        _M_DELTA.inc(status="bad_request")
+        return web.json_response(
+            {"message": 'Body must be {"users": {user_id: [factor, ...]}}.'},
+            status=400, headers=headers)
+    try:
+        out = await asyncio.to_thread(server.apply_delta, users)
+    except Exception as e:  # noqa: BLE001 — publish path must see a 500
+        log.exception("delta patch failed")
+        _M_DELTA.inc(status="error")
+        return web.json_response({"message": str(e)}, status=500,
+                                 headers=headers)
+    _M_DELTA.inc(status="ok" if out["appliedCount"] else "empty")
+    trace_event("serve.delta", users=out["appliedCount"],
+                epoch=out["epoch"])
+    return web.json_response({"message": "Patched", **out}, headers=headers)
+
+
 async def handle_health(request: web.Request) -> web.Response:
     """Liveness/readiness. 200 while serving (even degraded — the
     instance still answers queries on the fallback path), 503 while
@@ -979,6 +1184,7 @@ def create_engine_server_app(server: EngineServer) -> web.Application:
     app.router.add_get("/metrics", handle_metrics)
     app.router.add_get("/health.json", handle_health)
     app.router.add_get("/reload", handle_reload)
+    app.router.add_post("/reload/delta", handle_reload_delta)
     app.router.add_get("/stop", handle_stop)
 
     async def _drain_server(app):

@@ -75,6 +75,17 @@ Sites instrumented in this repo:
   barrier (sync site; the sync point where a dead peer surfaces to
   survivors — arm an ``error`` to prove the surviving process
   classifies the loss transient and aborts the step cleanly)
+- ``stream.tail``            — head of every streaming-updater journal
+  poll (``workflow/streaming.StreamingUpdater``; sync site; an
+  ``error`` models an unreadable journal partition — the cycle is
+  classified transient and retried, tail cursors untouched)
+- ``stream.fold_in``         — before each batched fold-in solve in the
+  streaming updater (sync site; an ``error`` models a failed device
+  dispatch — the batch is retried whole, never half-applied)
+- ``stream.publish``         — before each ``POST /reload/delta`` to
+  the engine server (sync site; an ``error`` is an unreachable server —
+  feeds the publish breaker, and the follow cursor must NOT advance so
+  a restart replays the batch; the exactly-once chaos test arms this)
 
 A fault is armed per site with a kind:
 
@@ -125,6 +136,9 @@ SITES: tuple[str, ...] = (
     "checkpoint.shard_write",
     "checkpoint.manifest_commit",
     "train.host_lost",
+    "stream.tail",
+    "stream.fold_in",
+    "stream.publish",
 )
 
 #: chaos runs must always be measurable: one counter series per site,

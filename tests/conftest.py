@@ -60,6 +60,14 @@ def pytest_configure(config):
         "guarded when also marked chaos; select with -m overload")
     config.addinivalue_line(
         "markers",
+        "streaming: streaming online-learning tests (the journal-tailing "
+        "fold-in updater, the /reload/delta hot-patch path and the "
+        "eval-gated promotion — workflow/streaming.py, "
+        "storage/journal.py JournalFollower; test_streaming.py); shares "
+        "the chaos guard's SIGALRM timeout and fault cleanup; select "
+        "with -m streaming")
+    config.addinivalue_line(
+        "markers",
         "retrieval: ANN / exact retrieval tests (the quantized IVF index, "
         "its exact-fallback and parity contracts, and the adaptive "
         "shard-count cost model — ops/ann.py, ops/retrieval.py; "
@@ -80,7 +88,8 @@ def _chaos_guard(request):
     disarm every injected fault on teardown — a leaked armed fault would
     poison unrelated tests."""
     if (request.node.get_closest_marker("chaos") is None
-            and request.node.get_closest_marker("train_chaos") is None):
+            and request.node.get_closest_marker("train_chaos") is None
+            and request.node.get_closest_marker("streaming") is None):
         yield
         return
 
@@ -117,7 +126,8 @@ def _multihost_guard(request):
     Composes with _chaos_guard by arming only when that guard didn't."""
     if (request.node.get_closest_marker("multihost") is None
             or request.node.get_closest_marker("chaos") is not None
-            or request.node.get_closest_marker("train_chaos") is not None):
+            or request.node.get_closest_marker("train_chaos") is not None
+            or request.node.get_closest_marker("streaming") is not None):
         yield
         return
 

@@ -3,6 +3,7 @@ mesh (the reference trusts MLlib for ALS math; we must test ours:
 reconstruction quality, implicit mode, neighbor-block layout, top-N)."""
 
 import numpy as np
+import pytest
 
 from predictionio_tpu.ops.neighbors import build_neighbor_blocks
 from predictionio_tpu.storage.bimap import BiMap
@@ -535,6 +536,83 @@ class TestFoldIn:
         u_mixed = m.fold_in_user(["nope", "i3"], [9.0, 4.0])
         u_known = m.fold_in_user(["i3"], [4.0])
         np.testing.assert_allclose(u_mixed, u_known, rtol=1e-6)
+
+    @pytest.mark.parametrize("implicit", [False, True])
+    def test_batched_matches_single_bitwise(self, rng, implicit):
+        """fold_in_users (the streaming updater's kernel) must be
+        BITWISE-identical to N independent fold_in_user calls — the
+        published patch is interchangeable with the reference solve."""
+        m = self._model(rng, implicit=implicit)
+        batch = [
+            (["i1", "i2", "i3"], [4.0, 3.0, 5.0]),
+            (["i7"], None),
+            (["i0", "i5", "i9", "i11", "i13"], [1.0, 2.0, 3.0, 4.0, 5.0]),
+        ]
+        factors, kept = m.fold_in_users(batch)
+        assert kept.tolist() == [True, True, True]
+        assert factors.dtype == np.float32
+        for j, (ids, r) in enumerate(batch):
+            ref = m.fold_in_user(ids, r)
+            assert np.array_equal(factors[j], ref)
+
+    def test_batched_unknown_skipping_and_dropped_users(self, rng):
+        """Unknown item ids are skipped inside a row; a user whose
+        events are ALL unknown is dropped (kept=False) and produces no
+        factor row — mirroring fold_in_user's None."""
+        m = self._model(rng)
+        batch = [
+            (["nope", "i3"], [9.0, 4.0]),   # mixed: unknown id skipped
+            (["nope", "nada"], None),        # all unknown: dropped
+            (["i2"], [2.0]),
+        ]
+        factors, kept = m.fold_in_users(batch)
+        assert kept.tolist() == [True, False, True]
+        assert factors.shape == (2, 6)
+        assert np.array_equal(factors[0], m.fold_in_user(["i3"], [4.0]))
+        assert np.array_equal(factors[1], m.fold_in_user(["i2"], [2.0]))
+        # everything unknown -> empty result, all dropped
+        f2, k2 = m.fold_in_users([(["zz"], None)])
+        assert f2.shape == (0, 6) and k2.tolist() == [False]
+
+    @pytest.mark.parametrize("implicit", [False, True])
+    def test_batched_device_solver_close(self, rng, implicit):
+        """The jitted device path (batched masked Gram + Cholesky) is an
+        f32 kernel — not bitwise, but tight against the f64 host path."""
+        m = self._model(rng, implicit=implicit)
+        batch = [(["i1", "i2", "i3"], [4.0, 3.0, 5.0]),
+                 (["i7", "i9"], [1.0, 2.0]),
+                 (["zz"], None)]
+        host, kept_h = m.fold_in_users(batch, solver="host")
+        dev, kept_d = m.fold_in_users(batch, solver="device")
+        assert kept_h.tolist() == kept_d.tolist() == [True, True, False]
+        np.testing.assert_allclose(dev, host, rtol=5e-4, atol=5e-4)
+
+    def test_vtv_cache_invalidated_on_item_factor_replace(self, rng):
+        """Regression (ISSUE 10 satellite): the implicit fold-in's cached
+        VᵀV is derived from item_factors — replacing the factors (the
+        reload/restore path) must drop it, or fold-in keeps solving
+        against the OLD catalog."""
+        m = self._model(rng, implicit=True)
+        before = m.fold_in_user(["i0", "i5"], [1.0, 3.0])
+        assert "_vtv_cache" in m.__dict__ or m._vtv() is not None
+        new_items = rng.standard_normal(m.item_factors.shape).astype(
+            np.float32)
+        m.item_factors = new_items  # __setattr__ hook drops the caches
+        assert "_vtv_cache" not in m.__dict__
+        after = m.fold_in_user(["i0", "i5"], [1.0, 3.0])
+        assert not np.array_equal(before, after)
+        # the post-replacement solve must equal a FRESH model's solve
+        fresh = self._model(rng, implicit=True)
+        fresh.item_factors = new_items
+        assert np.array_equal(after, fresh.fold_in_user(["i0", "i5"],
+                                                        [1.0, 3.0]))
+        # in-place mutation bypasses __setattr__ — the explicit
+        # invalidation hook covers it
+        m._vtv()  # warm the cache
+        m.item_factors[:] = rng.standard_normal(
+            m.item_factors.shape).astype(np.float32)
+        m.invalidate_item_caches()
+        assert "_vtv_cache" not in m.__dict__
 
     def test_fold_in_reproduces_trained_user(self, rng, mesh8):
         """At convergence a user's trained factor IS the half-step solve

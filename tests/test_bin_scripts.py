@@ -186,3 +186,18 @@ def test_pio_admin_reap_help_documents_flags(tmp_path):
     assert out.returncode == 0
     for flag in ("--stale-after-s", "--dry-run"):
         assert flag in out.stdout, f"{flag} missing from admin reap --help"
+
+
+def test_pio_stream_help_documents_updater_flags(tmp_path):
+    """ISSUE 10: the streaming updater's operator surface — `pio stream
+    --help` must advertise the journal-tailing, gating and publish
+    knobs the docs/operations.md runbook names."""
+    env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
+    out = subprocess.run([str(REPO / "bin" / "pio"), "stream", "--help"],
+                         capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    for flag in ("--journal-dir", "--engine-url", "--batch-window-ms",
+                 "--eval-gate", "--eval-k", "--journal-partitions",
+                 "--follow-name", "--max-records", "--fold-in-solver",
+                 "--breaker-threshold", "--breaker-reset-s"):
+        assert flag in out.stdout, f"{flag} missing from stream --help"
