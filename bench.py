@@ -1269,6 +1269,160 @@ finally:
             "streaming_foldin_users_per_sec": round(users_per_sec, 1)}
 
 
+def observability_overhead_bench() -> dict:
+    """ISSUE 11 gate: latency attribution must be cheap enough to leave
+    on. A real EngineServer pair (identical sample engine, batched path)
+    serves interleaved request blocks with instrumentation on vs off;
+    HARD GATE: instrumented p50 within 5% of uninstrumented (plus a
+    100 µs jitter floor — loopback HTTP p50s are ~ms, where 5% and
+    scheduler noise are the same order). Also replays a synthetic
+    availability burn through the SLO tracker on a fake clock and gates
+    on the burn-rate gauge actually moving past 1.0 — an SLO engine
+    whose gauges don't respond to a real error storm is decoration."""
+    code = r"""
+import asyncio, json, os, sys, tempfile, threading, time, urllib.request
+sys.path.insert(0, os.environ["REPO"])
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from aiohttp import web
+from predictionio_tpu.controller import Engine, EngineParams
+from predictionio_tpu.storage import Storage
+from predictionio_tpu.testing.sample_engine import (
+    SampleAlgoParams, SampleAlgorithm, SampleDataSource,
+    SampleDataSourceParams, SamplePreparator, SampleQuery, SampleServing)
+from predictionio_tpu.workflow import Context, run_train
+from predictionio_tpu.workflow.create_server import (
+    EngineServer, create_engine_server_app)
+
+class EchoAlgorithm(SampleAlgorithm):
+    query_class = SampleQuery
+
+def make_engine():
+    return Engine(data_source_classes=SampleDataSource,
+                  preparator_classes=SamplePreparator,
+                  algorithm_classes={"echo": EchoAlgorithm},
+                  serving_classes=SampleServing)
+
+Storage.reset()
+for repo in ("METADATA", "EVENTDATA", "MODELDATA"):
+    Storage.configure(repo, "memory")
+engine = make_engine()
+ep = EngineParams(
+    data_source_params=("", SampleDataSourceParams(id=0)),
+    algorithm_params_list=(("echo", SampleAlgoParams(id=1)),))
+iid = run_train(engine, ep, Context(), engine_factory="__main__:make_engine")
+instance = Storage.get_metadata().engine_instance_get(iid)
+
+def start(server):
+    loop = asyncio.new_event_loop()
+    ready, holder = threading.Event(), {}
+    async def _start():
+        runner = web.AppRunner(create_engine_server_app(server))
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        holder["port"] = runner.addresses[0][1]
+        ready.set()
+    def _run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(_start())
+        loop.run_forever()
+    threading.Thread(target=_run, daemon=True).start()
+    assert ready.wait(30), "engine server failed to start"
+    return holder["port"]
+
+tmp = tempfile.mkdtemp(prefix="pio_bench_obs_")
+ports = {}
+for label, flag in (("off", False), ("on", True)):
+    ports[label] = start(EngineServer(
+        engine, instance, instrumentation=flag,
+        flight_dump_dir=os.path.join(tmp, "flight_" + label)))
+
+import http.client
+BODY = json.dumps({"q": 1}).encode()
+conns = {label: http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+         for label, port in ports.items()}
+def block(label, n):
+    # one keep-alive connection per server: TCP setup out of the loop,
+    # so the p50 measures the serving path, not the socket stack
+    out, conn = [], conns[label]
+    for _ in range(n):
+        t0 = time.perf_counter()
+        conn.request("POST", "/queries.json", body=BODY,
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        assert r.status == 200
+        r.read()
+        out.append(time.perf_counter() - t0)
+    return out
+
+for label in ("off", "on"):   # warm: compile, caches, TCP stacks
+    block(label, 100)
+samples, deltas = {"off": [], "on": []}, []
+def p50(xs):
+    s = sorted(xs)
+    return s[len(s) // 2]
+for _ in range(6):            # paired rounds: ambient drift hits both
+    round_p50 = {}
+    for label in ("off", "on"):
+        xs = block(label, 150)
+        samples[label].extend(xs)
+        round_p50[label] = p50(xs)
+    deltas.append(round_p50["on"] - round_p50["off"])
+for label in ("off", "on"):
+    print("OBSOVH p50_%s %.6f" % (label, p50(samples[label])), flush=True)
+print("OBSOVH delta %.6f" % p50(deltas), flush=True)
+
+from predictionio_tpu.obs.metrics import METRICS
+from predictionio_tpu.obs.slo import SloTracker, default_objectives
+clock = {"t": 1000.0}
+tracker = SloTracker(default_objectives(deadline_s=0.1),
+                     now_fn=lambda: clock["t"])
+for _ in range(300):          # a healthy 75 s baseline, 4 req/s
+    clock["t"] += 0.25
+    tracker.observe(0.01, ok=True)
+base = METRICS.get("pio_slo_burn_rate").value("availability", "5m")
+for _ in range(120):          # 30 s total outage
+    clock["t"] += 0.25
+    tracker.observe(0.01, ok=False)
+burn = METRICS.get("pio_slo_burn_rate").value("availability", "5m")
+print("OBSOVH burn %.4f %.4f" % (base, burn), flush=True)
+"""
+    rows = {r[0]: r[1:] for r in _run_tagged_child(code, "OBSOVH", 600)}
+    p50_off = float(rows["p50_off"][0])
+    p50_on = float(rows["p50_on"][0])
+    delta = float(rows["delta"][0])  # median of paired per-round deltas
+    base, burn = float(rows["burn"][0]), float(rows["burn"][1])
+    # gate on the paired-round median delta, not the raw p50 ratio: the
+    # echo engine's sub-ms baseline puts 5% (~45 us) at the same scale
+    # as loopback scheduler jitter, and pairing cancels ambient drift.
+    # The 50 us floor is the resolution of this harness, not a license:
+    # real device-backed serving runs multi-ms, where 5% dominates it.
+    if delta > p50_off * 0.05 + 5e-5:
+        raise RuntimeError(
+            f"observability overhead gate: instrumentation adds "
+            f"{delta * 1e6:.0f} us to a {p50_off * 1e3:.3f} ms p50 "
+            f"(on={p50_on * 1e3:.3f} ms) — more than 5%; the waterfall/"
+            f"flight path must be cheap enough to leave on")
+    if burn <= 1.0 or burn <= base:
+        raise RuntimeError(
+            f"SLO burn gate: availability 5m burn went {base:.2f} -> "
+            f"{burn:.2f} under a synthetic 120-error storm; the gauge "
+            f"must cross 1.0 (budget breach) to be alertable")
+    pct = delta / p50_off * 100.0
+    log(f"observability overhead: serve p50 {p50_off * 1e3:.3f} ms off / "
+        f"{p50_on * 1e3:.3f} ms on, paired delta {delta * 1e6:+.0f} us "
+        f"({pct:+.1f}%); synthetic availability burn {base:.2f} -> "
+        f"{burn:.2f}")
+    return {"obs_overhead_p50_off_ms": round(p50_off * 1e3, 4),
+            "obs_overhead_p50_on_ms": round(p50_on * 1e3, 4),
+            "obs_overhead_delta_us": round(delta * 1e6, 1),
+            "obs_overhead_pct": round(pct, 2),
+            "slo_synthetic_burn_5m": round(burn, 2)}
+
+
 def _cache_dir() -> str:
     d = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".xla_cache")
     os.makedirs(d, exist_ok=True)
@@ -1635,6 +1789,7 @@ def main() -> None:
         ("event ingest", event_ingest_throughput, 900, False),
         ("ingest partition sweep", event_ingest_partition_sweep, 900, False),
         ("streaming fold-in", streaming_foldin_bench, 900, False),
+        ("observability overhead", observability_overhead_bench, 600, False),
     ]
     if platform != "tpu":
         # the e2e child pins itself to the host backend (PIO_PLATFORM),

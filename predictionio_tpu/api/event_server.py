@@ -38,7 +38,7 @@ from datetime import datetime
 
 from aiohttp import web
 
-from ..obs.http import handle_metrics
+from ..obs.http import handle_metrics, make_trace_middleware
 from ..obs.metrics import METRICS
 from ..obs.trace import TRACE_HEADER, ensure_request_id, trace_event
 from ..storage import (
@@ -499,6 +499,9 @@ async def handle_stats(request: web.Request) -> web.Response:
     adm: AdmissionController | None = request.app.get(ADMISSION_KEY)
     if adm is not None:
         body["admission"] = adm.stats()
+    slo = stats.slo_summary()
+    if slo is not None:
+        body["slo"] = slo
     return web.json_response(body)
 
 
@@ -572,8 +575,17 @@ def create_event_app(stats: bool = False,
     fsync) rides the app's startup/cleanup signals. ``admission``
     enables 429 shedding (journal pressure + per-key rate limits) on
     the write endpoints."""
-    app = web.Application()
-    app[STATS_KEY] = Stats() if stats else None
+    # ISSUE 11 satellite: every response carries X-PIO-Request-ID, not
+    # just the happy path — the webhook connectors, admission-shed 429s,
+    # journal-full 503s and auth 401s never called ensure_request_id, so
+    # their responses were unquotable in incident reports. setdefault in
+    # the middleware keeps the handlers' own stamps authoritative.
+    app = web.Application(middlewares=[make_trace_middleware()])
+    if stats:
+        from ..obs.slo import SloTracker, ingest_objectives
+        app[STATS_KEY] = Stats(slo=SloTracker(ingest_objectives()))
+    else:
+        app[STATS_KEY] = None
     app[INGEST_KEY] = ingestor
     app[ADMISSION_KEY] = admission
     app.router.add_get("/", handle_root)

@@ -46,12 +46,21 @@ def _hour_bucket(t: datetime) -> datetime:
 class Stats:
     """Hourly (appId, statusCode, ETE) counters. ``get`` reports the
     previous and current hour buckets (Stats.scala:51-93 keeps a rolling
-    pair the same way)."""
+    pair the same way).
 
-    def __init__(self):
+    ``slo`` (ISSUE 11) is an optional ``obs.slo.SloTracker``: every
+    booked outcome also feeds the ingest-availability objective, with
+    server-side failures (status >= 500) counting as bad — client
+    errors (400/401/429) spend no error budget."""
+
+    def __init__(self, slo=None):
         self._lock = threading.Lock()
+        self._slo = slo
         # bucket-hour -> Counter[(app_id, status, ETE)]
         self._buckets: dict[datetime, Counter] = {}
+
+    def slo_summary(self) -> dict | None:
+        return self._slo.summary() if self._slo is not None else None
 
     def update(self, app_id: int, status: int, *, entity_type: str = "",
                target_entity_type: str | None = None, event: str = "",
@@ -63,6 +72,8 @@ class Stats:
         and status-only rows are what makes /stats.json show rejected
         traffic next to accepted events."""
         now = now or datetime.now(timezone.utc)
+        if self._slo is not None:
+            self._slo.observe(0.0, ok=status < 500)
         ete = EntityTypesEvent(entity_type, target_entity_type, event)
         bucket = _hour_bucket(now)
         with self._lock:

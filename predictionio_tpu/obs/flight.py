@@ -1,0 +1,206 @@
+"""Always-on flight recorder: the last N request waterfalls, dumped on
+incident.
+
+A bounded ring buffer holds the most recent finished (or hung) request
+waterfalls together with ambient server context (mode, queue depth —
+whatever the registered context provider reports). When something goes
+wrong — the dispatch watchdog fires, the mode ladder enters brownout or
+degraded, or deadline expiries burst — the ring is dumped to a JSON
+incident file *at that moment*, capturing the requests that led into the
+incident rather than the ones that came after someone noticed.
+
+The ring is always on: recording one request is a dict build plus a
+deque append under a lock, no I/O. Dumps are rate-limited per reason
+(``cooldown_s``) so a flapping mode ladder cannot fill a disk.
+
+On-demand access: ``GET /debug/flight.json`` on the engine server and
+``pio admin flight`` both return :meth:`FlightRecorder.snapshot`.
+
+Like ``METRICS`` and ``FAULTS``, the process-wide singleton ``FLIGHT``
+is the one instance everything records into; tests reset it between
+cases via :meth:`reset`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from .metrics import METRICS
+
+__all__ = ["FlightRecorder", "FLIGHT"]
+
+_C_DUMPS = METRICS.counter(
+    "pio_flight_dumps_total",
+    "flight-recorder incident dumps written, by trigger reason",
+    labelnames=("reason",))
+_C_SUPPRESSED = METRICS.counter(
+    "pio_flight_dumps_suppressed_total",
+    "incident dumps suppressed by the per-reason cooldown",
+    labelnames=("reason",))
+_G_RECORDS = METRICS.gauge(
+    "pio_flight_records",
+    "request waterfalls currently held in the flight-recorder ring")
+
+
+def _default_dump_dir() -> str:
+    return (os.environ.get("PIO_FLIGHT_DIR")
+            or os.path.join(os.path.expanduser("~"), ".pio_tpu", "flight"))
+
+
+class FlightRecorder:
+    """Bounded ring of request-waterfall records + incident dumping."""
+
+    def __init__(self, capacity: int = 256, dump_dir: str | None = None,
+                 cooldown_s: float = 30.0, burst_threshold: int = 10,
+                 burst_window_s: float = 5.0):
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=capacity)
+        self.dump_dir = dump_dir
+        self.cooldown_s = cooldown_s
+        self.burst_threshold = burst_threshold
+        self.burst_window_s = burst_window_s
+        self._last_dump: dict[str, float] = {}   # reason -> monotonic
+        self._expiries: deque = deque(maxlen=1024)
+        self.last_dump_path: str | None = None
+        self.last_dump_reason: str | None = None
+        self.dumps = 0
+        self._context_fn = None
+
+    # -- configuration -----------------------------------------------
+    def configure(self, *, capacity: int | None = None,
+                  dump_dir: str | None = None,
+                  cooldown_s: float | None = None,
+                  burst_threshold: int | None = None,
+                  burst_window_s: float | None = None) -> None:
+        with self._lock:
+            if capacity is not None and capacity != self._ring.maxlen:
+                self._ring = deque(self._ring, maxlen=max(1, capacity))
+            if dump_dir is not None:
+                self.dump_dir = dump_dir
+            if cooldown_s is not None:
+                self.cooldown_s = cooldown_s
+            if burst_threshold is not None:
+                self.burst_threshold = burst_threshold
+            if burst_window_s is not None:
+                self.burst_window_s = burst_window_s
+
+    def set_context_provider(self, fn) -> None:
+        """``fn() -> dict`` of ambient server context (mode, queue depth,
+        inflight); called at record and dump time, exceptions swallowed —
+        observability must never take the server down."""
+        self._context_fn = fn
+
+    def _context(self) -> dict:
+        fn = self._context_fn
+        if fn is None:
+            return {}
+        try:
+            return dict(fn())
+        except Exception:
+            return {}
+
+    # -- recording -----------------------------------------------------
+    def record(self, waterfall_dict: dict) -> None:
+        """Append one finished request's waterfall to the ring."""
+        with self._lock:
+            self._ring.append(waterfall_dict)
+            _G_RECORDS.set(len(self._ring))
+
+    def note_hung(self, waterfall_dict: dict) -> None:
+        """Record a request the watchdog declared hung — pushed *before*
+        the incident dump so the dump contains the victim."""
+        d = dict(waterfall_dict)
+        d["hung"] = True
+        self.record(d)
+
+    def note_deadline_expired(self) -> str | None:
+        """Count one deadline expiry; when ``burst_threshold`` expiries
+        land within ``burst_window_s``, trigger a ``deadline_burst``
+        incident. Returns the dump path when one was written."""
+        now = time.monotonic()
+        with self._lock:
+            self._expiries.append(now)
+            cutoff = now - self.burst_window_s
+            recent = sum(1 for t in self._expiries if t >= cutoff)
+        if recent >= self.burst_threshold:
+            return self.incident("deadline_burst")
+        return None
+
+    # -- dumping -------------------------------------------------------
+    def snapshot(self) -> dict:
+        with self._lock:
+            records = list(self._ring)
+        return {
+            "capacity": self._ring.maxlen,
+            "records": records,
+            "context": self._context(),
+            "lastDump": {
+                "path": self.last_dump_path,
+                "reason": self.last_dump_reason,
+            },
+            "dumps": self.dumps,
+        }
+
+    def incident(self, reason: str, force: bool = False) -> str | None:
+        """Dump the ring to ``<dump_dir>/flight-<reason>-<ts>.json``.
+        Returns the path, or None when suppressed by the cooldown."""
+        now = time.monotonic()
+        with self._lock:
+            last = self._last_dump.get(reason)
+            if not force and last is not None and (
+                    now - last) < self.cooldown_s:
+                _C_SUPPRESSED.inc(reason=reason)
+                return None
+            self._last_dump[reason] = now
+        payload = self.snapshot()
+        payload["reason"] = reason
+        payload["wallTime"] = time.time()
+        dump_dir = self.dump_dir or _default_dump_dir()
+        path = os.path.join(
+            dump_dir, f"flight-{reason}-{int(time.time() * 1e3)}.json")
+        try:
+            os.makedirs(dump_dir, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(payload, f, indent=2, default=str)
+            os.replace(tmp, path)
+        except OSError:
+            return None  # a full disk must not take serving down
+        with self._lock:
+            self.last_dump_path = path
+            self.last_dump_reason = reason
+            self.dumps += 1
+        _C_DUMPS.inc(reason=reason)
+        return path
+
+    # -- views ---------------------------------------------------------
+    def stats(self) -> dict:
+        """Compact block for /stats.json."""
+        with self._lock:
+            return {
+                "records": len(self._ring),
+                "capacity": self._ring.maxlen,
+                "dumps": self.dumps,
+                "lastDumpReason": self.last_dump_reason,
+                "lastDumpPath": self.last_dump_path,
+            }
+
+    def reset(self) -> None:
+        """Test isolation: empty the ring and forget dump history (the
+        configuration — capacity, dump dir — survives)."""
+        with self._lock:
+            self._ring.clear()
+            self._expiries.clear()
+            self._last_dump.clear()
+            self.last_dump_path = None
+            self.last_dump_reason = None
+            self.dumps = 0
+            _G_RECORDS.set(0)
+
+
+#: the process-wide recorder every serve path records into
+FLIGHT = FlightRecorder()

@@ -9,8 +9,9 @@ processes that never serve HTTP (train workers, the CLI).
 from __future__ import annotations
 
 from .metrics import METRICS
+from .trace import TRACE_HEADER, ensure_request_id
 
-__all__ = ["handle_metrics", "CONTENT_TYPE"]
+__all__ = ["handle_metrics", "make_trace_middleware", "CONTENT_TYPE"]
 
 #: Prometheus text exposition v0.0.4 content type
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -23,3 +24,25 @@ async def handle_metrics(request):
         text=METRICS.render_prometheus(),
         headers={"Content-Type": CONTENT_TYPE},
     )
+
+
+def make_trace_middleware():
+    """aiohttp middleware that adopts/mints the request id at ingress and
+    stamps ``X-PIO-Request-ID`` on EVERY response — including paths that
+    bail before any handler bookkeeping runs (admission-shed 429s,
+    journal-full 503s, auth 401s, webhook errors). ``setdefault`` keeps
+    handler-set stamps authoritative."""
+    from aiohttp import web
+
+    @web.middleware
+    async def trace_middleware(request, handler):
+        rid = ensure_request_id(request.headers.get(TRACE_HEADER))
+        try:
+            resp = await handler(request)
+        except web.HTTPException as exc:
+            exc.headers.setdefault(TRACE_HEADER, rid)
+            raise
+        resp.headers.setdefault(TRACE_HEADER, rid)
+        return resp
+
+    return trace_middleware

@@ -1,0 +1,205 @@
+"""SLO burn-rate engine: declared objectives, multi-window burn rates.
+
+An :class:`Objective` declares what "good" means for one dimension of
+serving — e.g. *latency*: wall latency under a threshold for at least
+99% of requests; *availability*: non-server-error outcomes for at least
+99.9%. The :class:`SloTracker` books every request outcome into 5-second
+time buckets and reports, per objective and per window (5 m / 1 h), the
+**burn rate**: the observed bad fraction divided by the error budget
+``1 - target``.
+
+Burn rate reads directly as alert severity (Google SRE workbook
+multi-window convention): 1.0 means the error budget is being consumed
+exactly at the sustainable rate; 14.4 on the 5 m window means the whole
+30-day budget would be gone in ~2 days. The short window catches fast
+burns, the long window keeps the alert from flapping.
+
+Exported as labeled gauges (``pio_slo_burn_rate{slo,window}``,
+``pio_slo_bad_fraction{slo,window}``) plus an outcome counter, refreshed
+at most once per second on the observe path (computing a window sum
+walks up to 720 buckets — cheap, but not per-request cheap). The full
+:meth:`summary` recomputes fresh and feeds ``/health.json``,
+``/stats.json`` and the dashboard.
+
+``now_fn`` is injectable so the synthetic burn test can replay hours of
+traffic in milliseconds.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+
+from .metrics import METRICS
+
+__all__ = ["Objective", "SloTracker", "default_objectives",
+           "ingest_objectives"]
+
+_G_BURN = METRICS.gauge(
+    "pio_slo_burn_rate",
+    "error-budget burn rate per objective and window (1.0 = budget "
+    "consumed exactly at the sustainable rate)",
+    labelnames=("slo", "window"))
+_G_BAD = METRICS.gauge(
+    "pio_slo_bad_fraction",
+    "observed bad-event fraction per objective and window",
+    labelnames=("slo", "window"))
+_C_EVENTS = METRICS.counter(
+    "pio_slo_events_total",
+    "request outcomes booked against SLO objectives",
+    labelnames=("slo", "outcome"))
+
+#: multi-window burn convention: fast window catches, slow window confirms
+WINDOWS_S: dict[str, float] = {"5m": 300.0, "1h": 3600.0}
+
+_BUCKET_S = 5.0
+
+
+@dataclass(frozen=True)
+class Objective:
+    """One declared objective. ``kind`` is ``"latency"`` (bad when wall
+    latency exceeds ``threshold_s``) or ``"availability"`` (bad when the
+    request outcome was a server-side failure)."""
+
+    name: str
+    kind: str
+    target: float                     # e.g. 0.999 -> 0.1% error budget
+    threshold_s: float | None = None  # latency objectives only
+
+    def is_bad(self, latency_s: float, ok: bool) -> bool:
+        if self.kind == "latency":
+            return latency_s > float(self.threshold_s)
+        return not ok
+
+    @property
+    def budget(self) -> float:
+        return max(1.0 - self.target, 1e-9)
+
+
+def default_objectives(deadline_s: float = 0.25) -> list[Objective]:
+    """The serving defaults: p99-style latency under the request
+    deadline, availability three nines."""
+    return [
+        Objective(name="latency", kind="latency", target=0.99,
+                  threshold_s=deadline_s),
+        Objective(name="availability", kind="availability", target=0.999),
+    ]
+
+
+def ingest_objectives(target: float = 0.999) -> list[Objective]:
+    """The event plane's single objective: ingestion availability.
+    Latency is deliberately absent — the durable-journal write path is
+    bounded by fsync policy, and a latency SLO there would just alias
+    the journal metrics that already exist."""
+    return [Objective(name="ingest-availability", kind="availability",
+                      target=target)]
+
+
+class SloTracker:
+    """Time-bucketed outcome counts + burn-rate computation."""
+
+    def __init__(self, objectives: list[Objective] | None = None,
+                 now_fn=time.monotonic):
+        self.objectives = list(objectives or default_objectives())
+        self._now = now_fn
+        self._lock = threading.Lock()
+        n_buckets = int(max(WINDOWS_S.values()) / _BUCKET_S) + 2
+        # each entry: [bucket_start_s, {objective_name: [good, bad]}]
+        self._buckets: deque = deque(maxlen=n_buckets)
+        self._last_gauge_refresh = -1e18
+
+    def observe(self, latency_s: float, ok: bool = True) -> None:
+        """Book one request outcome against every objective."""
+        now = self._now()
+        bucket_start = now - (now % _BUCKET_S)
+        with self._lock:
+            if not self._buckets or self._buckets[-1][0] != bucket_start:
+                self._buckets.append(
+                    [bucket_start,
+                     {o.name: [0, 0] for o in self.objectives}])
+            counts = self._buckets[-1][1]
+            for o in self.objectives:
+                bad = o.is_bad(latency_s, ok)
+                slot = counts.setdefault(o.name, [0, 0])
+                slot[1 if bad else 0] += 1
+                _C_EVENTS.inc(slo=o.name, outcome="bad" if bad else "good")
+            refresh = (now - self._last_gauge_refresh) >= 1.0
+            if refresh:
+                self._last_gauge_refresh = now
+        if refresh:
+            self.refresh_gauges()
+
+    def _window_counts(self, window_s: float, now: float) -> dict:
+        """{objective: (good, bad)} over the trailing window."""
+        cutoff = now - window_s
+        out = {o.name: [0, 0] for o in self.objectives}
+        with self._lock:
+            for bucket_start, counts in self._buckets:
+                # a bucket counts while any part of it overlaps the window
+                if bucket_start + _BUCKET_S <= cutoff:
+                    continue
+                for name, (good, bad) in counts.items():
+                    slot = out.setdefault(name, [0, 0])
+                    slot[0] += good
+                    slot[1] += bad
+        return {k: (v[0], v[1]) for k, v in out.items()}
+
+    def burn_rates(self) -> dict:
+        """{objective: {window: burn_rate}} — 0.0 with no traffic."""
+        now = self._now()
+        out: dict = {}
+        for label, window_s in WINDOWS_S.items():
+            counts = self._window_counts(window_s, now)
+            for o in self.objectives:
+                good, bad = counts.get(o.name, (0, 0))
+                total = good + bad
+                frac = (bad / total) if total else 0.0
+                out.setdefault(o.name, {})[label] = frac / o.budget
+        return out
+
+    def refresh_gauges(self) -> None:
+        now = self._now()
+        for label, window_s in WINDOWS_S.items():
+            counts = self._window_counts(window_s, now)
+            for o in self.objectives:
+                good, bad = counts.get(o.name, (0, 0))
+                total = good + bad
+                frac = (bad / total) if total else 0.0
+                _G_BAD.set(frac, slo=o.name, window=label)
+                _G_BURN.set(frac / o.budget, slo=o.name, window=label)
+
+    def summary(self) -> dict:
+        """JSON block for /health.json, /stats.json and the dashboard.
+        ``breaching`` = fast-window burn above 1.0 (budget being eaten
+        faster than sustainable)."""
+        now = self._now()
+        by_window = {label: self._window_counts(window_s, now)
+                     for label, window_s in WINDOWS_S.items()}
+        objectives = []
+        any_breaching = False
+        for o in self.objectives:
+            windows = {}
+            for label in WINDOWS_S:
+                good, bad = by_window[label].get(o.name, (0, 0))
+                total = good + bad
+                frac = (bad / total) if total else 0.0
+                windows[label] = {
+                    "events": total,
+                    "badFraction": round(frac, 6),
+                    "burnRate": round(frac / o.budget, 4),
+                }
+            breaching = windows["5m"]["burnRate"] > 1.0
+            any_breaching = any_breaching or breaching
+            entry = {
+                "name": o.name,
+                "kind": o.kind,
+                "target": o.target,
+                "windows": windows,
+                "breaching": breaching,
+            }
+            if o.threshold_s is not None:
+                entry["thresholdMs"] = round(o.threshold_s * 1e3, 3)
+            objectives.append(entry)
+        return {"objectives": objectives, "breaching": any_breaching}

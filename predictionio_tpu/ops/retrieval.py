@@ -29,6 +29,7 @@ import threading
 import numpy as np
 
 from ..obs.metrics import METRICS
+from ..obs.waterfall import mark_stage, stage_sink_active
 from ..workflow.faults import FAULTS
 
 __all__ = ["topk_scores", "DeviceRetriever", "ShardedDeviceRetriever",
@@ -443,7 +444,24 @@ def _dispatch_topk(q: np.ndarray, n_total: int, k: int, invoke):
     b_pad, k_pad = _query_shapes(q.shape[0], k_eff, n_total)
     q = _pad_to(q, b_pad, 0)
     q = _pad_to(q, 128, 1)
+    # Stage waterfall (obs/waterfall.py): when a serve request is being
+    # attributed, split the invoke into dispatch (the call returning an
+    # async device handle) and compute (block_until_ready delta). The
+    # fence is conditional on an active sink so un-attributed callers
+    # (training, bench device-spin) keep the async pipeline untouched.
+    attributing = stage_sink_active()
+    if attributing:
+        mark_stage("host_assembly")
     out, is_packed = invoke(q, k_pad)
+    if attributing:
+        mark_stage("device_dispatch")
+        try:
+            import jax
+
+            jax.block_until_ready(out)
+        except Exception:
+            pass  # numpy results / non-jax invokes: nothing to fence
+        mark_stage("device_compute")
     if is_packed:
         host = np.asarray(out)  # packed: ONE pull
         vals = host[:b_orig, :k_eff]
@@ -452,6 +470,8 @@ def _dispatch_topk(q: np.ndarray, n_total: int, k: int, invoke):
         vals, idx = out
         vals = np.asarray(vals)[:b_orig, :k_eff]
         idx = np.asarray(idx)[:b_orig, :k_eff]
+    if attributing:
+        mark_stage("result_scatter")
     return (vals[0], idx[0]) if single else (vals, idx)
 
 

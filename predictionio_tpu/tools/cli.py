@@ -491,6 +491,10 @@ def cmd_deploy(args) -> int:
         engine_dir=engine_dir,
         retriever_mesh=_retriever_mesh(args.retriever_mesh),
         retrieval=_retrieval_params(engine_dir, args),
+        instrumentation=not args.no_instrumentation,
+        slo_latency_ms=args.slo_latency_ms,
+        flight_capacity=args.flight_capacity,
+        flight_dump_dir=args.flight_dir,
     )
     return 0
 
@@ -714,7 +718,7 @@ def cmd_adminserver(args) -> int:
 def cmd_dashboard(args) -> int:
     from ..tools.dashboard import run_dashboard
 
-    run_dashboard(ip=args.ip, port=args.port)
+    run_dashboard(ip=args.ip, port=args.port, engine_url=args.engine_url)
     return 0
 
 
@@ -747,6 +751,39 @@ def cmd_admin(args) -> int:
                 f"p50={h['p50'] * 1e3:9.3f}ms p95={h['p95'] * 1e3:9.3f}ms "
                 f"p99={h['p99'] * 1e3:9.3f}ms")
         return 0
+    if args.admin_command == "flight":
+        import urllib.request
+
+        url = args.url.rstrip("/") + "/debug/flight.json"
+        with urllib.request.urlopen(url, timeout=10) as r:
+            snap = json.loads(r.read().decode())
+        if args.json:
+            _ok(json.dumps(snap, indent=2, sort_keys=True))
+            return 0
+        ctx = snap.get("context") or {}
+        records = snap.get("records") or []
+        _ok(f"flight recorder: {len(records)}/{snap.get('capacity')} "
+            f"records, mode={ctx.get('mode', '?')}, "
+            f"queueDepth={ctx.get('queueDepth', '?')}, "
+            f"dumps={snap.get('dumps', 0)}")
+        last = snap.get("lastDump")
+        if last:
+            _ok(f"  last incident dump: {last.get('reason')} -> "
+                f"{last.get('path')}")
+        for rec in records[-max(1, args.last):]:
+            stages = rec.get("stagesMs") or {}
+            top = max(stages, key=stages.get) if stages else "-"
+            flags = []
+            if rec.get("hung"):
+                flags.append("HUNG")
+            if rec.get("stalledStage"):
+                flags.append(f"stalled@{rec['stalledStage']}")
+            http = (rec.get("context") or {}).get("http", "?")
+            tail = f" [{','.join(flags)}]" if flags else ""
+            _ok(f"  {str(rec.get('requestId', '?'))[:12]:12s} "
+                f"{rec.get('wallMs', 0.0):9.2f}ms http={http} "
+                f"slowest={top}{tail}")
+        return 0
     if args.admin_command == "reap":
         meta = _storage().get_metadata()
         reaped = reap_orphans(meta, stale_after_s=args.stale_after_s,
@@ -759,6 +796,41 @@ def cmd_admin(args) -> int:
             age = heartbeat_age_s(inst)
             _ok(f"  {verb} {inst.id} (engine={inst.engine_id}, last "
                 f"liveness {age:.0f}s ago) -> ABANDONED")
+    return 0
+
+
+def cmd_profile(args) -> int:
+    """``pio profile serve`` asks a LIVE engine server to capture a
+    jax.profiler trace of itself (POST /debug/profile) — profiling the
+    real serving process under real traffic, not a bench stand-in. The
+    server brackets the window with flight-recorder snapshots so the
+    trace can be lined up against the request waterfalls that fell
+    inside it; ``--out`` saves those brackets locally."""
+    import urllib.parse
+    import urllib.request
+
+    qs = {"seconds": str(args.seconds)}
+    if args.trace_dir:
+        qs["dir"] = args.trace_dir
+    url = (args.url.rstrip("/") + "/debug/profile?"
+           + urllib.parse.urlencode(qs))
+    req = urllib.request.Request(url, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=args.seconds + 30) as r:
+            body = json.loads(r.read().decode())
+    except OSError as e:
+        _die(f"profile capture failed against {args.url}: {e}")
+    _ok(f"Captured {body.get('seconds')}s profiler trace -> "
+        f"{body.get('traceDir')} (on the server host)")
+    _ok("  view with TensorBoard/XProf: tensorboard --logdir <traceDir>")
+    if args.out:
+        out = Path(args.out)
+        out.mkdir(parents=True, exist_ok=True)
+        for key, stem in (("flightBefore", "before"), ("flightAfter",
+                                                       "after")):
+            p = out / f"flight-{stem}.json"
+            p.write_text(json.dumps(body.get(key), indent=2))
+            _ok(f"  wrote {p}")
     return 0
 
 
@@ -1044,6 +1116,19 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--brownout-topk", type=int, default=10,
                     help="top-k clamp applied to queries while the "
                          "server is in brownout")
+    sp.add_argument("--no-instrumentation", action="store_true",
+                    help="disable per-request stage waterfalls (SLO "
+                         "accounting and aggregate histograms stay on)")
+    sp.add_argument("--slo-latency-ms", type=float, default=0.0,
+                    help="latency-SLO threshold in ms (bad = slower); "
+                         "0 uses --deadline-ms, else 250")
+    sp.add_argument("--flight-capacity", type=int, default=256,
+                    help="flight recorder ring size: how many recent "
+                         "request waterfalls /debug/flight.json and "
+                         "incident dumps retain (default 256)")
+    sp.add_argument("--flight-dir", default=None,
+                    help="incident dump directory (default "
+                         "$PIO_FLIGHT_DIR or ~/.pio_tpu/flight)")
 
     sp = sub.add_parser("batchpredict")
     _add_engine_args(sp)
@@ -1168,6 +1253,10 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("dashboard")
     sp.add_argument("--ip", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=9000)
+    sp.add_argument("--engine-url", default="http://localhost:8000",
+                    help="engine server whose SLO burn rates and stage "
+                         "waterfalls /slo.json proxies "
+                         "(default http://localhost:8000)")
 
     sp = sub.add_parser("status")
     sp.add_argument("--checkpoint-dir", default=None,
@@ -1190,6 +1279,36 @@ def build_parser() -> argparse.ArgumentParser:
                               "(counters, gauges, histogram quantiles)")
     x.add_argument("--json", action="store_true",
                    help="machine-readable snapshot instead of the table")
+    x = a_sub.add_parser("flight",
+                         help="fetch a live engine server's flight "
+                              "recorder: the last N request waterfalls "
+                              "with mode/queue context")
+    x.add_argument("--url", default="http://localhost:8000",
+                   help="engine server base URL "
+                        "(default http://localhost:8000)")
+    x.add_argument("--json", action="store_true",
+                   help="raw /debug/flight.json instead of the table")
+    x.add_argument("--last", type=int, default=20,
+                   help="show only the newest N records (default 20)")
+
+    sp = sub.add_parser("profile",
+                        help="capture accelerator profiler traces")
+    pr_sub = sp.add_subparsers(dest="profile_command", required=True)
+    x = pr_sub.add_parser("serve",
+                          help="capture a jax.profiler trace of a LIVE "
+                               "engine server for --seconds, bracketed "
+                               "by flight-recorder snapshots")
+    x.add_argument("--url", default="http://localhost:8000",
+                   help="engine server base URL "
+                        "(default http://localhost:8000)")
+    x.add_argument("--seconds", type=float, default=5.0,
+                   help="capture window length (default 5, max 120)")
+    x.add_argument("--trace-dir", default=None,
+                   help="trace output directory ON THE SERVER HOST "
+                        "(default: a fresh dir under its tmpdir)")
+    x.add_argument("--out", default=None,
+                   help="also write flight-before.json/flight-after.json "
+                        "bracketing the window into this local directory")
 
     sp = sub.add_parser("import")
     sp.add_argument("--appid", type=int, required=True)
@@ -1228,6 +1347,7 @@ COMMANDS = {
     "dashboard": cmd_dashboard,
     "status": cmd_status,
     "admin": cmd_admin,
+    "profile": cmd_profile,
     "import": cmd_import,
     "export": cmd_export,
     "template": cmd_template,

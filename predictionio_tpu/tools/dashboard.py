@@ -5,6 +5,12 @@ tools/dashboard/Dashboard.scala:52-141 + CorsSupport.scala): lists
 completed evaluation instances newest-first and serves each instance's
 evaluator results as text/HTML/JSON on
 ``/engine_instances/<id>/evaluator_results.{txt,html,json}``.
+
+ISSUE 11 adds ``GET /slo.json``: a server-side proxy of the deployed
+engine server's SLO burn rates, stage-waterfall summary and flight-
+recorder state (the dashboard runs in its own process, so the local
+metrics registry says nothing about serving — the data lives on the
+engine server's /stats.json).
 """
 
 from __future__ import annotations
@@ -20,6 +26,33 @@ from ..storage import Storage
 log = logging.getLogger("predictionio_tpu.dashboard")
 
 __all__ = ["create_dashboard_app", "run_dashboard"]
+
+ENGINE_URL_KEY = web.AppKey("engine_url", str)
+
+
+async def handle_slo(request: web.Request) -> web.Response:
+    """Proxy the engine server's SLO/waterfall/flight blocks. 502 with
+    the reason when the engine server is unreachable — the dashboard
+    must render something either way."""
+    import aiohttp
+
+    base = request.query.get("url") or request.app[ENGINE_URL_KEY]
+    try:
+        timeout = aiohttp.ClientTimeout(total=5)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            async with session.get(base.rstrip("/") + "/stats.json") as r:
+                stats = await r.json()
+    except Exception as e:  # noqa: BLE001 — report, don't crash the page
+        return web.json_response(
+            {"engineUrl": base, "error": f"engine server unreachable: {e}"},
+            status=502)
+    return web.json_response({
+        "engineUrl": base,
+        "slo": stats.get("slo"),
+        "waterfall": stats.get("waterfall"),
+        "flight": stats.get("flight"),
+        "mode": stats.get("mode"),
+    })
 
 
 @web.middleware
@@ -58,7 +91,10 @@ async def handle_index(request: web.Request) -> web.Response:
         "<h1>Completed evaluations</h1>"
         "<table border=1><tr><th>ID</th><th>start</th><th>end</th>"
         "<th>evaluation</th><th>generator</th><th>batch</th><th>results</th></tr>"
-        f"{rows}</table></body></html>"
+        f"{rows}</table>"
+        '<p>Serving SLO burn rates and stage waterfalls: '
+        '<a href="/slo.json">/slo.json</a> (proxied from the engine '
+        "server's /stats.json)</p></body></html>"
     )
     return web.Response(text=body, content_type="text/html")
 
@@ -94,9 +130,12 @@ async def handle_results_json(request: web.Request) -> web.Response:
     )
 
 
-def create_dashboard_app() -> web.Application:
+def create_dashboard_app(
+        engine_url: str = "http://localhost:8000") -> web.Application:
     app = web.Application(middlewares=[cors_middleware])
+    app[ENGINE_URL_KEY] = engine_url
     app.router.add_get("/", handle_index)
+    app.router.add_get("/slo.json", handle_slo)
     app.router.add_get(
         "/engine_instances/{instance_id}/evaluator_results.txt", handle_results_txt
     )
@@ -110,7 +149,9 @@ def create_dashboard_app() -> web.Application:
     return app
 
 
-def run_dashboard(ip: str = "127.0.0.1", port: int = 9000) -> None:
+def run_dashboard(ip: str = "127.0.0.1", port: int = 9000,
+                  engine_url: str = "http://localhost:8000") -> None:
     logging.basicConfig(level=logging.INFO)
     log.info("Dashboard starting on %s:%d", ip, port)
-    web.run_app(create_dashboard_app(), host=ip, port=port, print=None)
+    web.run_app(create_dashboard_app(engine_url), host=ip, port=port,
+                print=None)

@@ -70,23 +70,50 @@ def clustered_items(n_items: int, rank: int, *, batch: int = 0,
 
 def _timed_rows(ret, q, *, batch, k, iters):
     """p50/p95/p99 + QPS of a batched topk through ``ret``, the same
-    timed loop for every retriever flavor."""
+    timed loop for every retriever flavor.
+
+    ISSUE 11: the loop also runs with a stage-waterfall sink installed,
+    so the retrieval path's ``mark_stage`` calls attribute each
+    iteration's time to host_assembly / device_dispatch / device_compute
+    / result_scatter — the row carries a ``stage_breakdown`` of mean ms
+    per stage plus the host/device share split. The waterfall is local
+    (never finished), so bench iterations stay out of the registry's
+    serving histograms."""
+    from ..obs.waterfall import (DEVICE_STAGES, STAGES, Waterfall,
+                                 reset_stage_sink, set_stage_sink)
+
     hist = Histogram("pio_bench_serve_seconds",
                      "one batched topk round trip (device call + the "
                      "single packed host pull)", buckets=_BENCH_BUCKETS_S)
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        # chaos site: arm `slow` to model a degraded device under
-        # generated load — the delay lands inside the timed window,
-        # so it shows up in the emitted latency percentiles
-        FAULTS.fire("loadgen.slow_device")
-        vals, _ = ret.topk(q, k)
-        np.asarray(vals)  # host fence: time includes the one pull
-        hist.record(time.perf_counter() - t0)
+    wf = Waterfall(path="bench")
+    token = set_stage_sink(wf)
+    try:
+        for _ in range(iters):
+            # re-seat the cursor: time between iterations (loop
+            # bookkeeping, FAULTS dispatch) must not leak into the
+            # first marked stage of the next iteration
+            wf.cursor()
+            t0 = time.perf_counter()
+            # chaos site: arm `slow` to model a degraded device under
+            # generated load — the delay lands inside the timed window,
+            # so it shows up in the emitted latency percentiles
+            FAULTS.fire("loadgen.slow_device")
+            vals, _ = ret.topk(q, k)
+            np.asarray(vals)  # host fence: time includes the one pull
+            hist.record(time.perf_counter() - t0)
+    finally:
+        reset_stage_sink(token)
     snap = hist.snapshot()
+    total = sum(wf.stages.values())
+    device = sum(wf.stages.get(s, 0.0) for s in DEVICE_STAGES)
     return {"p50_ms": snap["p50"] * 1e3, "p95_ms": snap["p95"] * 1e3,
             "p99_ms": snap["p99"] * 1e3,
-            "qps": batch / max(snap["p50"], 1e-9)}
+            "qps": batch / max(snap["p50"], 1e-9),
+            "stage_breakdown": {
+                s: round(wf.stages[s] / max(iters, 1) * 1e3, 4)
+                for s in STAGES if s in wf.stages},
+            "host_share": round((total - device) / total, 4) if total else None,
+            "device_share": round(device / total, 4) if total else None}
 
 
 def _recall_at_k(approx_idx, exact_idx) -> float:
@@ -210,6 +237,19 @@ def format_table(rows: list[dict]) -> str:
         lines.append(line)
     if any(r.get("auto") for r in rows):
         lines.append("(* = width chosen by the catalog-size cost model)")
+    if any(r.get("stage_breakdown") for r in rows):
+        lines.append("stage breakdown (mean ms/iter; dev = "
+                     "device_dispatch+device_compute share):")
+        for r in rows:
+            bd = r.get("stage_breakdown")
+            if not bd:
+                continue
+            ways = f"{r['ways']}*" if r.get("auto") else str(r["ways"])
+            label = ways + (f"/{r['mode']}" if "mode" in r else "")
+            stages = "  ".join(f"{s}={ms:.3f}" for s, ms in bd.items())
+            dev = r.get("device_share")
+            share = f"  dev={dev:.0%}" if dev is not None else ""
+            lines.append(f"  {label:>8}  {stages}{share}")
     return "\n".join(lines)
 
 

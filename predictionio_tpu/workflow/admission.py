@@ -424,6 +424,21 @@ class AdmissionController:
         threshold (hysteresis: exit < enter)."""
         return self.brownout_pressure <= self.brownout_exit
 
+    def pressure_snapshot(self) -> dict:
+        """Tiny view for the flight recorder's ambient context: the two
+        composite pressures plus the hottest contributing signal. Reads
+        the cached sample only — never resamples, so it is safe to call
+        from an incident-dump path that must not add load."""
+        with self._lock:
+            signals = dict(self._signals)
+            shed_p, brown_p = self.shed_pressure, self.brownout_pressure
+        hot = max(signals, key=signals.get, default=None)
+        return {
+            "pressure": round(shed_p, 4),
+            "brownoutPressure": round(brown_p, 4),
+            "hotSignal": hot,
+        }
+
     def stats(self) -> dict:
         """JSON-friendly view for /health.json and /stats.json."""
         with self._lock:

@@ -46,6 +46,8 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import os
+import tempfile
 import threading
 import json
 import logging
@@ -59,9 +61,13 @@ from aiohttp import web
 
 from ..controller.engine import Engine, TrainResult
 from ..controller.params import parse_params
-from ..obs.http import handle_metrics
+from ..obs.flight import FLIGHT
+from ..obs.http import handle_metrics, make_trace_middleware
 from ..obs.metrics import METRICS
+from ..obs.slo import SloTracker, default_objectives
 from ..obs.trace import TRACE_HEADER, ensure_request_id, trace_event
+from ..obs.waterfall import (Waterfall, mark_stage, reset_stage_sink,
+                             set_stage_sink, stage_summary)
 from ..storage import EngineInstance, Storage
 from .admission import AdmissionController
 from .faults import FAULTS
@@ -114,6 +120,10 @@ _M_DELTA_EPOCH = METRICS.gauge(
     "pio_delta_patch_epoch",
     "monotonic serving-bundle patch epoch (bumps per applied delta batch "
     "and per full-reload reconciliation)")
+# ISSUE 11: live jax.profiler windows served via POST /debug/profile
+_M_PROFILE = METRICS.counter(
+    "pio_profile_captures_total",
+    "live jax.profiler traces captured of the serving process")
 
 
 def _to_jsonable(x: Any) -> Any:
@@ -278,6 +288,10 @@ class EngineServer:
         brownout_topk: int = 10,
         retrieval: dict | None = None,
         patch_table_max: int = 100_000,
+        instrumentation: bool = True,
+        slo_latency_ms: float = 0.0,
+        flight_capacity: int = 256,
+        flight_dump_dir: str | None = None,
     ):
         self.engine = engine
         self.ctx = ctx or Context(mode="Serving")
@@ -384,6 +398,44 @@ class EngineServer:
                 rate_limit_qps=rate_limit_qps,
                 rate_limit_burst=rate_limit_burst,
             )
+        # ISSUE 11: latency attribution. Per-request stage waterfalls +
+        # flight-recorder capture are always-on by default; the switch
+        # exists ONLY so the bench overhead gate can measure the
+        # instrumentation-off baseline it compares against.
+        self.instrumentation = instrumentation
+        # SLO engine: latency objective defaults to the request deadline
+        # (a request slower than its deadline was worthless), 250 ms when
+        # no deadline is configured; availability is always three nines.
+        slo_latency_s = (
+            slo_latency_ms / 1e3 if slo_latency_ms > 0
+            else (self.deadline_ms / 1e3 if self.deadline_ms > 0 else 0.25))
+        self.slo = SloTracker(default_objectives(deadline_s=slo_latency_s))
+        # flight recorder: the process singleton, configured per server
+        # (ONE engine per process today; the singleton matches METRICS/
+        # FAULTS idiom and lets the micro-batcher push hung waterfalls
+        # without holding a server reference)
+        self.flight = FLIGHT
+        self.flight.configure(capacity=flight_capacity,
+                              dump_dir=flight_dump_dir)
+        self.flight.set_context_provider(self._flight_context)
+        self._profiling = False  # one live jax.profiler window at a time
+
+    def _flight_context(self) -> dict:
+        """Ambient context stamped into flight snapshots/dumps: what the
+        server looked like at capture time."""
+        b = self.batcher
+        ctx = {
+            "mode": self._mode,
+            "queueDepth": len(b._pending) if b else 0,
+            "inflight": b._live if b else 0,
+            "maxInflight": b.max_inflight if b else None,
+            "watchdogTrips": b.watchdog_trips if b else 0,
+            "deadlineExpired": b.deadline_expired if b else 0,
+            "draining": self._draining,
+        }
+        if self.admission is not None:
+            ctx["admission"] = self.admission.pressure_snapshot()
+        return ctx
 
     # -- resilience: unified mode (normal/brownout/degraded), deadlines ----
     @property
@@ -404,6 +456,11 @@ class EngineServer:
         self.degraded_since = now_iso if mode == "degraded" else None
         self.brownout_since = now_iso if mode == "brownout" else None
         log.warning("server mode: %s -> %s", prev, mode)
+        if mode in ("brownout", "degraded"):
+            # ISSUE 11: entering a degraded rung is an incident — dump
+            # the flight ring NOW, while it still holds the requests
+            # that led in (cooldown-limited inside the recorder)
+            self.flight.incident(f"mode_{mode}")
 
     def _update_brownout(self) -> None:
         """Enter/leave brownout from admission pressure. Never touches
@@ -451,6 +508,10 @@ class EngineServer:
                 "max_inflight shrunk to %d; probe in %.1fs",
                 self.batcher.max_inflight if self.batcher else 0,
                 self.degraded_cooldown_s)
+        # the micro-batcher pushed the hung members' waterfalls into the
+        # ring (stalled stage stamped) before calling this hook, so the
+        # watchdog dump contains its victims
+        self.flight.incident("watchdog")
         self._probe_at = time.monotonic() + self.degraded_cooldown_s
 
     def _exit_degraded(self) -> None:
@@ -599,6 +660,10 @@ class EngineServer:
                 "dispatchTimeoutS": self.dispatch_timeout_s,
             },
             "drain": {"active": self._draining, "complete": self._drained},
+            # ISSUE 11: burn rates next to liveness — the first question
+            # after "is it up" is "is it eating its error budget"
+            "slo": self.slo.summary(),
+            "flight": self.flight.stats(),
             "model": {
                 "engineInstanceId": inst.id,
                 "fallbackActive": bool(self.deploy_skips),
@@ -636,6 +701,10 @@ class EngineServer:
         device call); serving blends per query as usual.
         """
         FAULTS.fire("server.serve_batch")
+        # stage waterfall: time since the previous stage (the to_thread
+        # hop on the fallback path; ~0 on the batched path, whose clock
+        # just marked batch_form) is waiting-to-be-served time
+        mark_stage("queue_wait")
         t0 = time.perf_counter()
         bundle = self.deployed  # snapshot reference (atomic swap safety)
         result = bundle.result
@@ -682,6 +751,10 @@ class EngineServer:
                 outcomes.append(("ok", _to_jsonable(served)))
             except Exception as e:  # noqa: BLE001
                 outcomes.append(("err", e))
+        # serving blend + outcome packaging (and, for models with no
+        # device retriever, the host predict itself — documented in
+        # obs/waterfall.py) is result-scatter work
+        mark_stage("result_scatter")
 
         dt = time.perf_counter() - t0
         with self._stats_lock:
@@ -909,13 +982,16 @@ class EngineServer:
             **({"batching": self.batcher.stats()} if self.batcher else {}),
         }
 
-    def _retrieval_stats(self) -> dict | None:
+    def _retrieval_stats(self, bundle: "Deployed | None" = None,
+                         ) -> dict | None:
         """The deployed bundle's retrieval posture: the first attached
         retriever's stats() (AnnRetriever: index cells / nprobe /
         quantize / build seconds / exact-fallback flag), a plain mode
         marker for exact device retrievers, None when serving from host
-        scoring."""
-        for model in self.deployed.result.models:
+        scoring. Pass the bundle snapshot serving_stats took under the
+        reload lock so the block cannot tear against a concurrent swap."""
+        bundle = bundle if bundle is not None else self.deployed
+        for model in bundle.result.models:
             r = getattr(model, "_retriever", None)
             if r is None:
                 continue
@@ -937,6 +1013,26 @@ class EngineServer:
                 "avgServingSec": self.avg_serving_sec,
                 "lastServingSec": self.last_serving_sec,
             }
+        # ISSUE 11 fix: every bundle-derived sub-block snapshots under
+        # the reload lock, so a concurrent delta patch / full reload can
+        # never interleave a torn view (patch epoch from the new bundle,
+        # model/retrieval blocks from the old). The bundle reference is
+        # immutable once swapped, so the derived retrieval stats are
+        # computed OUTSIDE the lock from the snapshot.
+        with self._reload_lock:
+            bundle = self.deployed
+            model_block = {
+                "engineInstanceId": bundle.instance.id,
+                "fallbackActive": bool(self.deploy_skips),
+                "skipped": self.deploy_skips,
+            }
+            patches_block = {
+                "epoch": self.patch_epoch,
+                "patchedUsers": len(self.patch_table),
+                "tableMax": self.patch_table_max,
+                "discardedByReload": self.patch_discarded,
+            }
+
         def _hist(name: str):
             h = METRICS.get(name)
             return h.snapshot() if h is not None else None
@@ -951,11 +1047,16 @@ class EngineServer:
                 "dispatch": _hist("pio_microbatch_dispatch_seconds"),
                 "device": _hist("pio_microbatch_device_seconds"),
             },
+            # ISSUE 11: per-stage attribution + host/device split — the
+            # live answer to "where did the milliseconds go"
+            "waterfall": stage_summary(),
+            "slo": self.slo.summary(),
+            "flight": self.flight.stats(),
             "batching": self.batcher.stats() if self.batcher else None,
             "execCache": EXEC_CACHE.stats(),
             # ISSUE 7: the active retrieval mode + ANN index facts
             # (cells / nprobe / quantize / build seconds / fallback)
-            "retrieval": self._retrieval_stats(),
+            "retrieval": self._retrieval_stats(bundle),
             "admission": (self.admission.stats()
                           if self.admission is not None else None),
             "resilience": {
@@ -971,18 +1072,9 @@ class EngineServer:
                                     if self.batcher else 0),
                 "draining": self._draining,
             },
-            "model": {
-                "engineInstanceId": self.deployed.instance.id,
-                "fallbackActive": bool(self.deploy_skips),
-                "skipped": self.deploy_skips,
-            },
+            "model": model_block,
             # ISSUE 10: streaming delta hot-patch posture
-            "patches": {
-                "epoch": self.patch_epoch,
-                "patchedUsers": len(self.patch_table),
-                "tableMax": self.patch_table_max,
-                "discardedByReload": self.patch_discarded,
-            },
+            "patches": patches_block,
             "feedback": self.feedback.stats() if self.feedback else None,
         }
 
@@ -998,11 +1090,30 @@ async def handle_query(request: web.Request) -> web.Response:
     # echoes the id so the client can quote it back
     rid = ensure_request_id(request.headers.get(TRACE_HEADER))
     t0 = time.perf_counter()
+    # ISSUE 11: per-request stage waterfall. Installed as the ambient
+    # stage sink so the FALLBACK path's to_thread worker (which copies
+    # this context) marks straight onto it; the batched path's shared
+    # stages ride the dispatch BatchClock and merge in at completion.
+    wf = sink_token = None
+    if server.instrumentation:
+        wf = Waterfall(rid=rid)
+        sink_token = set_stage_sink(wf)
 
     def _done(status_label: str, body: dict, status: int = 200,
               retry_after_s: float | None = None) -> web.Response:
-        _M_SERVE.record(time.perf_counter() - t0)
+        wall = time.perf_counter() - t0
+        _M_SERVE.record(wall)
         _M_QUERIES.inc(status=status_label)
+        # SLO accounting is always on (independent of the waterfall
+        # switch): latency objective sees the client-observed wall;
+        # availability counts server-side failures (5xx) as bad
+        server.slo.observe(wall, ok=status < 500)
+        if wf is not None:
+            reset_stage_sink(sink_token)
+            wf.finish(status_label)
+            wf.meta["http"] = status
+            wf.meta["mode"] = server.mode
+            server.flight.record(wf.to_dict())
         trace_event("serve.ingress", status=status_label,
                     http=status, ms=round((time.perf_counter() - t0) * 1e3, 3))
         headers = {TRACE_HEADER: rid}
@@ -1036,6 +1147,9 @@ async def handle_query(request: web.Request) -> web.Response:
     if not isinstance(query_json, dict):
         return _done("bad_request",
                      {"message": "Query must be a JSON object."}, 400)
+    # body parsed + admission decided: everything since ingress is the
+    # admission stage; the batcher (or fallback path) owns time from here
+    mark_stage("admission")
     try:
         result = await server.dispatch_query(
             server.brownout_degrade(query_json),
@@ -1158,6 +1272,52 @@ async def handle_health(request: web.Request) -> web.Response:
     return web.json_response(body, status=503 if server.draining else 200)
 
 
+async def handle_flight(request: web.Request) -> web.Response:
+    """GET /debug/flight.json — the always-on flight recorder: the last
+    N request waterfalls with mode/queue context, the same payload the
+    recorder dumps to disk on an incident. Safe to hit in production —
+    it is a ring snapshot, no locks shared with the serve path beyond
+    the recorder's own."""
+    server: EngineServer = request.app[SERVER_KEY]
+    return web.json_response(server.flight.snapshot())
+
+
+async def handle_profile(request: web.Request) -> web.Response:
+    """POST /debug/profile?seconds=S[&dir=...] — capture a jax.profiler
+    trace of the LIVE serving process for S seconds, bracketed by flight
+    snapshots so the trace can be lined up against the waterfalls that
+    fell inside the window. One capture at a time (409 while busy)."""
+    server: EngineServer = request.app[SERVER_KEY]
+    try:
+        seconds = float(request.query.get("seconds", "5"))
+    except ValueError:
+        return web.json_response({"message": "seconds must be a number"},
+                                 status=400)
+    seconds = min(max(seconds, 0.1), 120.0)
+    trace_dir = request.query.get("dir") or os.path.join(
+        tempfile.gettempdir(), f"pio-profile-{int(time.time() * 1e3)}")
+    if server._profiling:
+        return web.json_response(
+            {"message": "a profile capture is already running"}, status=409)
+    server._profiling = True
+    try:
+        before = server.flight.snapshot()
+        from .tracing import maybe_profile
+        with maybe_profile(trace_dir):
+            await asyncio.sleep(seconds)
+        after = server.flight.snapshot()
+        _M_PROFILE.inc()
+    finally:
+        server._profiling = False
+    return web.json_response({
+        "message": "Profile captured",
+        "traceDir": trace_dir,
+        "seconds": seconds,
+        "flightBefore": before,
+        "flightAfter": after,
+    })
+
+
 async def handle_stop(request: web.Request) -> web.Response:
     server: EngineServer = request.app[SERVER_KEY]
 
@@ -1176,7 +1336,10 @@ async def handle_stop(request: web.Request) -> web.Response:
 
 
 def create_engine_server_app(server: EngineServer) -> web.Application:
-    app = web.Application()
+    # trace middleware is defense in depth: handle_query stamps its own
+    # header (setdefault keeps those authoritative) but aiohttp-raised
+    # errors (404, 405, oversized body) get stamped here too
+    app = web.Application(middlewares=[make_trace_middleware()])
     app[SERVER_KEY] = server
     app.router.add_post("/queries.json", handle_query)
     app.router.add_get("/", handle_status)
@@ -1185,6 +1348,8 @@ def create_engine_server_app(server: EngineServer) -> web.Application:
     app.router.add_get("/health.json", handle_health)
     app.router.add_get("/reload", handle_reload)
     app.router.add_post("/reload/delta", handle_reload_delta)
+    app.router.add_get("/debug/flight.json", handle_flight)
+    app.router.add_post("/debug/profile", handle_profile)
     app.router.add_get("/stop", handle_stop)
 
     async def _drain_server(app):

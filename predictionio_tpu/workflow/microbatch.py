@@ -46,8 +46,11 @@ import logging
 import time
 from typing import Any, Callable, Sequence
 
+from ..obs.flight import FLIGHT
 from ..obs.metrics import METRICS
 from ..obs.trace import current_request_id, trace_event
+from ..obs.waterfall import (BatchClock, current_sink, reset_stage_sink,
+                             set_stage_sink)
 from .faults import FAULTS
 
 log = logging.getLogger("predictionio_tpu.server")
@@ -137,7 +140,9 @@ class MicroBatcher:
         self._last_arrival: float | None = None
         self.last_window_s = 0.0 if adaptive else self.window_s
         #: (query, future, absolute-monotonic deadline | None,
-        #:  enqueue instant, trace id | None)
+        #:  enqueue instant, trace id | None,
+        #:  stage waterfall sink | None — the submitting request's
+        #:  obs/waterfall.Waterfall, captured from its context)
         self._pending: list[tuple] = []
         self._wake: asyncio.Event | None = None
         self._task: asyncio.Task | None = None
@@ -183,6 +188,7 @@ class MicroBatcher:
         if deadline is not None and time.monotonic() >= deadline:
             self.deadline_expired += 1
             _M_DEADLINE.inc()
+            FLIGHT.note_deadline_expired()
             trace_event("serve.deadline_expired", where="submit")
             raise DeadlineExceeded("request deadline expired before submit")
         if len(self._pending) >= self.max_pending:
@@ -210,7 +216,8 @@ class MicroBatcher:
             self._note_arrival(time.monotonic())
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending.append(
-            (query, fut, deadline, time.monotonic(), current_request_id()))
+            (query, fut, deadline, time.monotonic(), current_request_id(),
+             current_sink()))
         assert self._wake is not None
         self._wake.set()
         return await fut
@@ -333,13 +340,18 @@ class MicroBatcher:
             return
         keep: list[tuple] = []
         for item in self._pending:
-            query, fut, dl, t_enq, rid = item
+            query, fut, dl, t_enq, rid, *rest = item
             if dl is not None and dl <= now:
                 self.deadline_expired += 1
                 _M_DEADLINE.inc()
+                FLIGHT.note_deadline_expired()
                 trace_event("serve.deadline_expired", trace=rid,
                             where="queued",
                             waited_ms=round((now - t_enq) * 1e3, 3))
+                wf = rest[0] if rest else None
+                if wf is not None:
+                    # the time it rotted in the queue IS its queue_wait
+                    wf.add("queue_wait", now - t_enq)
                 if not fut.done():
                     fut.set_exception(DeadlineExceeded(
                         "request deadline expired while queued"))
@@ -381,16 +393,30 @@ class MicroBatcher:
             self._inflight.add(task)
             task.add_done_callback(self._inflight.discard)
 
-    def _call_batch_fn(self, queries: list) -> list:
+    def _call_batch_fn(self, queries: list, clock: BatchClock | None = None,
+                       ) -> list:
         """Runs in the dispatch worker thread; the chaos harness's hang/
         error/slow site for 'a device call wedged' lives here so an
-        injected hang occupies the thread exactly like a real one."""
+        injected hang occupies the thread exactly like a real one.
+
+        ``clock`` is this dispatch's batch stage accumulator, installed
+        as the ambient stage sink for the thread (to_thread gave it a
+        private context copy) so serve_query_batch/_dispatch_topk marks
+        land on the batch clock, not on any one member's waterfall. The
+        fault site fires BEFORE the first mark: a hang here shows up as
+        stalled before any stage completed (stalledStage=batch_form)."""
         FAULTS.fire("microbatch.dispatch")
+        token = None
+        if clock is not None:
+            token = set_stage_sink(clock)
+            clock.mark("batch_form")  # batch cut -> worker thread running
         t0 = time.perf_counter()
         try:
             return self.batch_fn(queries)
         finally:
             _M_DEVICE.record(time.perf_counter() - t0)
+            if token is not None:
+                reset_stage_sink(token)
 
     def _zombie_done(self, task: asyncio.Task) -> None:
         self._zombies -= 1
@@ -414,13 +440,18 @@ class MicroBatcher:
         self.peak_inflight = max(self.peak_inflight, self._live)
         t_start = time.monotonic()
         traces = [t[4] for t in batch if len(t) > 4 and t[4]]
+        wfs = [t[5] for t in batch if len(t) > 5 and t[5] is not None]
         for t in batch:
             if len(t) > 3:
                 _M_QUEUE_WAIT.record(t_start - t[3])
+            if len(t) > 5 and t[5] is not None:
+                # per-member queue wait: its enqueue -> this batch cut
+                t[5].add("queue_wait", t_start - t[3])
+        clock = BatchClock() if wfs else None
         try:
             queries = [t[0] for t in batch]
             inner = asyncio.ensure_future(
-                asyncio.to_thread(self._call_batch_fn, queries))
+                asyncio.to_thread(self._call_batch_fn, queries, clock))
             try:
                 if self.dispatch_timeout_s is not None:
                     # shield: on timeout the outer wait is cancelled but
@@ -449,6 +480,16 @@ class MicroBatcher:
                 err = DispatchTimeout(
                     f"batch dispatch exceeded {self.dispatch_timeout_s}s "
                     f"watchdog; slot reclaimed")
+                # stamp the hung members' waterfalls with the stage the
+                # batch stalled in and push them into the flight ring
+                # BEFORE on_watchdog dumps it — the incident file must
+                # contain its victims
+                stalled = clock.in_progress() if clock is not None else None
+                for wf in wfs:
+                    if clock is not None:
+                        wf.merge_batch(clock)
+                    wf.stalled_stage = stalled
+                    FLIGHT.note_hung(wf.to_dict())
                 for _, fut, *_rest in batch:
                     if not fut.done():
                         fut.set_exception(err)
@@ -463,6 +504,12 @@ class MicroBatcher:
                     if not fut.done():
                         fut.set_exception(e)
                 return
+            if clock is not None:
+                # hand the batch-shared stage time to every member: each
+                # request lived through the whole formation/assembly/
+                # device step, so each is attributed the full duration
+                for wf in wfs:
+                    wf.merge_batch(clock)
             self.batches += 1
             self.batched_queries += len(batch)
             self.max_seen_batch = max(self.max_seen_batch, len(batch))

@@ -159,16 +159,25 @@ def clean_storage():
 
 
 @pytest.fixture(autouse=True)
-def _reset_metrics():
+def _reset_metrics(tmp_path):
     """Zero the process-wide telemetry registry between tests. reset()
     zeroes values IN PLACE, so the metric handles subsystems captured at
     import time stay valid — a test asserting on a counter always starts
-    from 0 without re-importing the world."""
+    from 0 without re-importing the world.
+
+    The flight recorder (also process-wide) resets too, with its
+    incident-dump directory pointed INTO the test's tmp dir — a chaos
+    test tripping the watchdog must never write to ~/.pio_tpu."""
+    from predictionio_tpu.obs.flight import FLIGHT
     from predictionio_tpu.obs.metrics import METRICS
 
     METRICS.reset()
+    FLIGHT.reset()
+    FLIGHT.configure(capacity=256, dump_dir=str(tmp_path / "flight"),
+                     cooldown_s=30.0)
     yield
     METRICS.reset()
+    FLIGHT.reset()
 
 
 @pytest.fixture(scope="session")

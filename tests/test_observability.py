@@ -246,6 +246,44 @@ def test_sites_matches_literal_fire_call_sites():
     assert found == set(faults.SITES)
 
 
+def test_every_pio_metric_is_documented_in_operations_md():
+    """Every ``pio_*`` metric family must have a catalog row in
+    docs/operations.md — telemetry nobody can look up is noise
+    (ISSUE 11 guard). Two sweeps, unioned: the live registry after
+    importing the whole package (catches families registered under
+    computed names, e.g. the per-stage waterfall histograms built from
+    an f-string), and a source scan of literal METRICS registrations
+    (catches families a test run might not import). ``Histogram``
+    instances constructed outside the registry (serve_bench's local
+    timer) are intentionally out of scope: they never reach /metrics."""
+    import importlib
+    import pkgutil
+
+    import predictionio_tpu as pkg_mod
+
+    for info in pkgutil.walk_packages(pkg_mod.__path__,
+                                      prefix="predictionio_tpu."):
+        importlib.import_module(info.name)
+
+    with METRICS._lock:
+        names = {n for n in METRICS._metrics if n.startswith("pio_")}
+
+    root = pathlib.Path(pkg_mod.__file__).resolve().parent
+    for p in root.rglob("*.py"):
+        for m in re.finditer(
+                r'METRICS\.(?:counter|gauge|histogram)\(\s*'
+                r'["\'](pio_[a-z0-9_]+)["\']',
+                p.read_text()):
+            names.add(m.group(1))
+    assert names, "metric sweep found nothing — the scan regex rotted"
+
+    doc = (root.parent / "docs" / "operations.md").read_text()
+    undocumented = sorted(n for n in names if f"`{n}`" not in doc)
+    assert not undocumented, (
+        "metrics missing a docs/operations.md catalog row: "
+        + ", ".join(undocumented))
+
+
 @pytest.mark.chaos
 def test_fired_fault_increments_site_counter():
     before = METRICS.get("faults_injected_total").value("journal.append")
