@@ -877,21 +877,26 @@ def sharded_retrieval_bench() -> dict:
 from predictionio_tpu.tools.serve_bench import sweep
 
 for r in sweep((1, 2, 4, 8)):
-    print("SHARDEDRET %d %.3f %.3f %.3f %.1f %s %.4f %d" % (
+    print("SHARDEDRET %d %.3f %.3f %.3f %.1f %s %.4f %d %.3f %d" % (
         r["ways"], r["p50_ms"], r["p95_ms"], r["p99_ms"], r["qps"],
-        r["merge"], r["exec_cache_hit_rate"], r["batch"]))
+        r["merge"], r["exec_cache_hit_rate"], r["batch"],
+        r["compile_seconds"], r["hbm_bytes"]))
 """
     res = {}
     rows = _run_tagged_child(code, "SHARDEDRET", 900)
-    for ways, p50_ms, p95_ms, p99_ms, qps, merge, hit_rate, batch in rows:
+    for (ways, p50_ms, p95_ms, p99_ms, qps, merge, hit_rate, batch,
+         compile_s, hbm_bytes) in rows:
         res[f"sharded_topk_{ways}way_p50_ms"] = float(p50_ms)
         res[f"sharded_topk_{ways}way_p95_ms"] = float(p95_ms)
         res[f"sharded_topk_{ways}way_p99_ms"] = float(p99_ms)
         res[f"sharded_topk_{ways}way_qps"] = round(float(qps))
+        # ISSUE 12: device-side evidence from the ledger rides each row
+        res[f"sharded_topk_{ways}way_compile_s"] = float(compile_s)
+        res[f"sharded_topk_{ways}way_hbm_bytes"] = int(hbm_bytes)
         res["sharded_topk_merge"] = merge
         res["sharded_topk_exec_cache_hit_rate"] = float(hit_rate)
         res["sharded_topk_batch"] = int(batch)
-    if len(res) != 19:  # 4 ways x 4 + 3 shared fields
+    if len(res) != 27:  # 4 ways x 6 + 3 shared fields
         raise RuntimeError(f"sharded retrieval bench incomplete: {res}")
     log(f"sharded retrieval sweep (64k x 64 catalog, batch-128 top-10, "
         f"virtual CPU mesh, merge={res['sharded_topk_merge']}, exec-cache "
@@ -932,9 +937,10 @@ for n in (65_536, GATE_N):
             "ANN must beat exact at %d items: %.0f <= %.0f qps"
             % (n, by["ann"]["qps"], by["exact"]["qps"]))
     for r in rows:
-        print("ANNRET mode %d %s %.4f %.3f %.1f %.3f %s" % (
+        print("ANNRET mode %d %s %.4f %.3f %.1f %.3f %s %.3f %d" % (
             n, r["mode"], r["recall_at_k"], r["p50_ms"], r["qps"],
-            r["build_s"], r["merge"]))
+            r["build_s"], r["merge"], r["compile_seconds"],
+            r["hbm_bytes"]))
 
 chosen = choose_shard_count(65_536, len(jax.devices()))
 for r in sweep((1, 8), n_items=65_536, iters=8):
@@ -944,10 +950,13 @@ for r in sweep((1, 8), n_items=65_536, iters=8):
     res = {}
     for row in _run_tagged_child(code, "ANNRET", 900):
         if row[0] == "mode":
-            _, n, mode, recall, p50, qps, build_s, merge = row
+            _, n, mode, recall, p50, qps, build_s, merge, comp_s, hbm = row
             key = f"retrieval_{mode}_{int(n) // 1024}k"
             res[key + "_p50_ms"] = float(p50)
             res[key + "_qps"] = round(float(qps))
+            # ISSUE 12: ledger-derived device-side evidence per row
+            res[key + "_compile_s"] = float(comp_s)
+            res[key + "_hbm_bytes"] = int(hbm)
             if mode == "ann":
                 res[key + "_recall_at_10"] = float(recall)
                 res[key + "_build_s"] = float(build_s)
@@ -957,7 +966,7 @@ for r in sweep((1, 8), n_items=65_536, iters=8):
             res[f"retrieval_shard_{ways}way_qps"] = round(float(qps))
             if chosen == "1":
                 res["retrieval_autoshard_chosen_ways"] = int(ways)
-    if len(res) != 17:  # 2 sizes x (exact 2 + ann 5) + 2 shard + chosen
+    if len(res) != 25:  # 2 sizes x (exact 4 + ann 7) + 2 shard + chosen
         raise RuntimeError(f"ann retrieval bench incomplete: {res}")
     ch = res["retrieval_autoshard_chosen_ways"]
     if (ch == 8 and res["retrieval_shard_8way_qps"]

@@ -46,6 +46,7 @@ import time
 import numpy as np
 
 from ..obs.metrics import METRICS
+from ..obs.training import TRAINING
 from ..ops.neighbors import build_bilinear_layout
 from ..ops.retrieval import RetrievalServingMixin
 from ..storage.bimap import BiMap
@@ -344,9 +345,10 @@ class ALSModel(RetrievalServingMixin):
             vf[vf == 0.0] = 1e-30
             vals[i, :rows.size] = vf
         run = _fold_in_program(cfg.rank, cfg.implicit_prefs,
-                               float(cfg.alpha), float(cfg.lambda_))
+                               float(cfg.alpha), float(cfg.lambda_),
+                               b_pad, d_pad, self.item_factors.shape[0])
         x = run(jnp.asarray(ids), jnp.asarray(vals),
-                jnp.asarray(self.item_factors))
+                jnp.asarray(self.item_factors, jnp.float32))
         return np.asarray(x)[:len(prep)].astype(np.float32)
 
     def similar_items(self, item_rows: list[int], num: int,
@@ -663,34 +665,45 @@ def _ridge(other_c, n, *, lambda_, implicit):
     return lambda_ * jnp.maximum(n, 1.0), None
 
 
-def _fold_in_program(rank: int, implicit: bool, alpha: float, lambda_: float):
-    """Jitted batched fold-in: [B, D] gathered events → _gram_blocks →
-    regularized batched Cholesky. One compiled program per (rank, mode)
-    pair; jit's own cache handles the padded (B, D) shapes. Exact
-    factorization, not CG — fold-in has no next half-step to absorb an
-    inexact inner solve."""
-    key = (rank, implicit, alpha, lambda_)
-    prog = _FOLD_IN_PROGRAMS.get(key)
-    if prog is not None:
-        return prog
-    import jax
+def _fold_in_program(rank: int, implicit: bool, alpha: float, lambda_: float,
+                     b_pad: int, d_pad: int, n_items: int):
+    """AOT-compiled batched fold-in: [B, D] gathered events →
+    _gram_blocks → regularized batched Cholesky. Exact factorization,
+    not CG — fold-in has no next half-step to absorb an inexact inner
+    solve.
 
-    def run(ids, vals, item_factors):
-        a, b, n = _gram_blocks(ids[None], vals[None], item_factors,
-                               implicit=implicit, alpha=alpha, rank=rank,
-                               masked=True)
-        nb = ids.shape[0]
-        shift, gram = _ridge(item_factors, n.reshape(-1), lambda_=lambda_,
-                             implicit=implicit)
-        return _spd_solve(a.reshape(nb, rank, rank), b.reshape(nb, rank),
-                          solver="cholesky", shift=shift, gram=gram)
+    Compiled through the shared ``ExecutableCache`` (key namespace
+    ``"fold_in"``, fully shape-qualified) rather than a private jit
+    cache: a long-lived streaming updater then shares the serving
+    executable budget AND every fold-in compile lands in the device
+    ledger's HBM/compile accounting (ISSUE 12)."""
+    from ..ops.retrieval import EXEC_CACHE
 
-    prog = jax.jit(run)
-    _FOLD_IN_PROGRAMS[key] = prog
-    return prog
+    key = ("fold_in", rank, implicit, alpha, lambda_, b_pad, d_pad, n_items)
 
+    def build():
+        import jax
+        import jax.numpy as jnp
 
-_FOLD_IN_PROGRAMS: dict = {}
+        def run(ids, vals, item_factors):
+            a, b, n = _gram_blocks(ids[None], vals[None], item_factors,
+                                   implicit=implicit, alpha=alpha, rank=rank,
+                                   masked=True)
+            nb = ids.shape[0]
+            shift, gram = _ridge(item_factors, n.reshape(-1), lambda_=lambda_,
+                                 implicit=implicit)
+            return _spd_solve(a.reshape(nb, rank, rank),
+                              b.reshape(nb, rank),
+                              solver="cholesky", shift=shift, gram=gram)
+
+        sds = jax.ShapeDtypeStruct
+        return jax.jit(run).lower(
+            sds((b_pad, d_pad), jnp.int32),
+            sds((b_pad, d_pad), jnp.float32),
+            sds((n_items, rank), jnp.float32),
+        ).compile()
+
+    return EXEC_CACHE.get_or_build(key, build)
 
 
 def _half_step(ids, vals, other, *, lambda_, implicit, alpha, rank,
@@ -992,6 +1005,57 @@ def make_train_step(mesh, u_layout, i_layout, *, rank, lambda_=0.1,
     return jax.jit(step, out_shardings=(fac, fac), donate_argnums=(2, 3))
 
 
+class _ConvergenceSampler:
+    """Sampled-holdout convergence probe for the training loop
+    (ISSUE 12): a fixed seeded sample of <=512 rating triples, scored
+    against the live factor matrices each iteration — sampled RMSE plus
+    the relative user-factor delta norm, streamed into ``TRAINING``.
+    Factors live in PERMUTED slot order during training, so true rows
+    map through ``SideLayout.pos`` once at construction; the per-
+    iteration cost is one [S, R] gather per side (S <= 512), far below
+    the half-steps it measures. Pure telemetry: any failure disables
+    the probe, never the run."""
+
+    SAMPLE = 512
+
+    def __init__(self, ratings: Ratings, config: ALSConfig, u_lay, i_lay):
+        self.ok = False
+        self._prev = None
+        try:
+            n = int(len(ratings.ratings))
+            take = min(self.SAMPLE, n)
+            if take == 0:
+                return
+            rng = np.random.default_rng((config.seed or 0) ^ 0x5EED)
+            sel = rng.choice(n, size=take, replace=False)
+            self.u_slots = np.asarray(u_lay.pos)[
+                np.asarray(ratings.user_indices)[sel]]
+            self.i_slots = np.asarray(i_lay.pos)[
+                np.asarray(ratings.item_indices)[sel]]
+            self.r = np.asarray(ratings.ratings)[sel].astype(np.float32)
+            self.ok = True
+        except Exception:
+            self.ok = False
+
+    def observe(self, it: int, u, v, step_seconds: float) -> None:
+        loss = delta = None
+        if self.ok:
+            try:
+                uu = np.asarray(u[self.u_slots], np.float32)
+                vv = np.asarray(v[self.i_slots], np.float32)
+                pred = (uu * vv).sum(axis=1)
+                loss = float(np.sqrt(np.mean((pred - self.r) ** 2)))
+                if self._prev is not None:
+                    delta = float(
+                        np.linalg.norm(uu - self._prev)
+                        / (np.linalg.norm(self._prev) + 1e-12))
+                self._prev = uu
+            except Exception:
+                loss = delta = None
+        TRAINING.observe("train", it, loss=loss, delta_norm=delta,
+                         step_seconds=step_seconds)
+
+
 def train_als(ratings: Ratings, config: ALSConfig, mesh=None, *,
               checkpointer=None, checkpoint_every: int = 0) -> ALSModel:
     """Alternate user/item half-steps for ``config.iterations`` rounds.
@@ -1146,13 +1210,17 @@ def train_als(ratings: Ratings, config: ALSConfig, mesh=None, *,
     )
     u = None
     carry_u = u_restored if u_restored is not None else u_seed
+    conv = _ConvergenceSampler(ratings, config, u_lay, i_lay)
+    TRAINING.begin("train", total_iterations=config.iterations)
     for it in range(start_it, config.iterations):
         # chaos site: a preemption striking mid-training (arm with
         # after=N to let N iterations — and their checkpoints — land)
         FAULTS.fire("train.step")
         t_step = time.perf_counter()
         u, v = step(u_bk, i_bk, carry_u, v)
-        _M_TRAIN_STEP.record(time.perf_counter() - t_step)
+        step_s = time.perf_counter() - t_step
+        _M_TRAIN_STEP.record(step_s)
+        conv.observe(it, u, v, step_s)
         carry_u = u
         done = it + 1
         if (checkpointer is not None and checkpoint_every > 0

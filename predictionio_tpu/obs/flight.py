@@ -159,6 +159,15 @@ class FlightRecorder:
         payload = self.snapshot()
         payload["reason"] = reason
         payload["wallTime"] = time.time()
+        try:
+            # ISSUE 12: OOM-adjacent incidents are self-contained — the
+            # dump carries the HBM watermark + top executables by bytes.
+            # Local import: flight is imported by device's metric deps.
+            from .device import LEDGER
+
+            payload["deviceLedger"] = LEDGER.incident_brief()
+        except Exception:
+            pass  # telemetry-of-telemetry must never block a dump
         dump_dir = self.dump_dir or _default_dump_dir()
         path = os.path.join(
             dump_dir, f"flight-{reason}-{int(time.time() * 1e3)}.json")

@@ -25,9 +25,11 @@ from __future__ import annotations
 import functools
 import itertools
 import threading
+import time
 
 import numpy as np
 
+from ..obs.device import LEDGER
 from ..obs.metrics import METRICS
 from ..obs.waterfall import mark_stage, stage_sink_active
 from ..workflow.faults import FAULTS
@@ -100,7 +102,12 @@ class ExecutableCache:
                 return val
             self.misses += 1
         _M_EXEC_CACHE.inc(event="miss")
+        t0 = time.perf_counter()
         val = build()
+        # analysis probes outside the lock (they can walk the whole HLO);
+        # residency bookkeeping (admit/discard) inside, in lockstep with
+        # the insert/evict it accounts for — ISSUE 12's HBM ledger
+        entry = LEDGER.analyze(key, val, time.perf_counter() - t0)
         with self._lock:
             if key in self._entries:
                 return self._entries[key]  # lost the build race
@@ -112,7 +119,9 @@ class ExecutableCache:
                 self._entries.pop(victim)
                 self.evictions += 1
                 _M_EXEC_CACHE.inc(event="evict")
+                LEDGER.discard(victim)
             self._entries[key] = val
+            LEDGER.admit(entry)
         return val
 
     def pin(self, key) -> None:
@@ -442,6 +451,7 @@ def _dispatch_topk(q: np.ndarray, n_total: int, k: int, invoke):
         return (empty_v[0], empty_i[0]) if single else (empty_v, empty_i)
     b_orig = q.shape[0]
     b_pad, k_pad = _query_shapes(q.shape[0], k_eff, n_total)
+    LEDGER.record_padding_waste(b_orig, b_pad)
     q = _pad_to(q, b_pad, 0)
     q = _pad_to(q, 128, 1)
     # Stage waterfall (obs/waterfall.py): when a serve request is being

@@ -106,6 +106,11 @@ class EngineInstance:
     #: attempt (tracing.phase_times_json) — `pio status` shows where the
     #: run's wall clock went. Empty for pre-telemetry records.
     phase_times: str = ""
+    #: JSON list of per-attempt convergence summaries (obs/training
+    #: ConvergenceTracker.summaries: final/first loss, iterations run,
+    #: mean step seconds, final delta norm) stamped at the final status
+    #: flip — `pio status` prints them. Empty for pre-telemetry records.
+    convergence: str = ""
     #: JSON map of per-process liveness for elastic multi-host runs:
     #: ``{"<process_id>": {"ts": iso, "attempt": n}}``. Each process of
     #: the run stamps its own entry; ``pio status`` shows all of them and

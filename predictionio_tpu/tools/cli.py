@@ -37,6 +37,7 @@ import json
 import logging
 import os
 import sys
+import time
 from pathlib import Path
 
 from .. import __version__
@@ -904,6 +905,22 @@ def cmd_status(args) -> int:
                 f"{p}={dt:.2f}s"
                 for p, dt in sorted(phases, key=lambda x: -x[1]))
             _ok(f"  completed run {inst.id}: {total:.2f}s ({breakdown})")
+            # ISSUE 12: per-attempt convergence summary from the run's
+            # stamped ConvergenceTracker record
+            try:
+                attempts = (json.loads(inst.convergence)
+                            if getattr(inst, "convergence", "") else [])
+            except ValueError:
+                attempts = []
+            for n, att in enumerate(attempts):
+                loss = att.get("finalLoss")
+                step = att.get("meanStepSeconds")
+                _ok(f"    convergence attempt {n}: "
+                    f"{att.get('iterations', 0)} iteration(s), "
+                    f"final loss "
+                    f"{f'{loss:.4f}' if loss is not None else 'n/a'}, "
+                    f"mean step "
+                    f"{f'{step * 1e3:.1f}ms' if step is not None else 'n/a'}")
     except Exception as e:  # noqa: BLE001
         _ok(f"  completed runs: unavailable ({e})")
     try:
@@ -918,6 +935,138 @@ def cmd_status(args) -> int:
             if False else "Your system is all ready to go.")
         return 0
     return 1
+
+
+def _fmt_bytes(n) -> str:
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}GiB"
+
+
+def _top_frame(stats: dict, prev: tuple[float, int] | None) -> list[str]:
+    """Render one `pio top` frame from an engine /stats.json snapshot.
+    ``prev`` is (monotonic_ts, requestCount) from the previous frame —
+    the qps window. Pure function of its inputs (unit-testable)."""
+    lines: list[str] = []
+    mode = (stats.get("resilience") or {}).get("mode", "?")
+    count = int(stats.get("requestCount") or 0)
+    qps = None
+    if prev is not None:
+        dt = time.monotonic() - prev[0]
+        if dt > 0:
+            qps = max(0, count - prev[1]) / dt
+    serving = (stats.get("latency") or {}).get("serving") or {}
+    p50 = serving.get("p50")
+    lines.append(
+        f"pio top · mode={mode} · requests={count}"
+        + (f" · qps={qps:.1f}" if qps is not None else "")
+        + (f" · p50={p50 * 1e3:.2f}ms" if p50 else ""))
+    slo = stats.get("slo") or {}
+    breaching = [o["name"] for o in slo.get("objectives", [])
+                 if o.get("breaching")]
+    burns = [((o.get("windows") or {}).get("5m") or {}).get("burnRate")
+             for o in slo.get("objectives", [])]
+    burns = [b for b in burns if b is not None]
+    lines.append(
+        f"slo: {'BREACHING ' + ','.join(breaching) if breaching else 'ok'}"
+        + (f" · max 5m burn={max(burns):.2f}x" if burns else ""))
+    cache = stats.get("execCache") or {}
+    if cache:
+        lines.append(
+            f"exec cache: {cache.get('size', 0)} entries "
+            f"({cache.get('pinned', 0)} pinned) · "
+            f"hit rate {cache.get('hitRate', 0.0):.0%} · "
+            f"{cache.get('evictions', 0)} evictions")
+    device = stats.get("device") or {}
+    comps = device.get("components") or {}
+    lines.append(
+        f"hbm ledger: total {_fmt_bytes(device.get('totalBytes'))} · "
+        f"watermark {_fmt_bytes(device.get('watermarkBytes'))}")
+    for name, c in sorted(comps.items(),
+                          key=lambda kv: -kv[1].get("bytes", 0)):
+        flag = "  [analysisUnavailable]" if c.get("analysisUnavailable") \
+            else ""
+        lines.append(
+            f"  {name:12s} {_fmt_bytes(c.get('bytes')):>10s}  "
+            f"{c.get('entries', 0)} executable(s){flag}")
+    for e in (device.get("topExecutables") or [])[:5]:
+        lines.append(
+            f"    {e.get('kind', '?'):8s} {_fmt_bytes(e.get('totalBytes')):>10s}"
+            f"  compile={e.get('compileSeconds', 0.0):.2f}s  {e.get('key', '')[:48]}")
+    waste = device.get("paddingWaste") or {}
+    if waste.get("count"):
+        lines.append(
+            f"padding waste: p50={waste.get('p50', 0.0):.0%} "
+            f"p95={waste.get('p95', 0.0):.0%} over {waste['count']} "
+            "dispatch(es)")
+    train = stats.get("train") or {}
+    for source in sorted(train):
+        block = train[source] or {}
+        live = block.get("live")
+        if live:
+            hist = live.get("history") or []
+            last = hist[-1] if hist else {}
+            total = live.get("totalIterations")
+            parts = [f"iter {live.get('iterations', 0)}"
+                     + (f"/{total}" if total else "")]
+            if last.get("loss") is not None:
+                parts.append(f"loss={last['loss']:.4f}")
+            if last.get("deltaNorm") is not None:
+                parts.append(f"Δ={last['deltaNorm']:.3g}")
+            if last.get("stepSeconds") is not None:
+                parts.append(f"step={last['stepSeconds'] * 1e3:.0f}ms")
+            lines.append(f"{source}: live · " + " · ".join(parts))
+        attempts = block.get("attempts") or []
+        if attempts:
+            att = attempts[-1]
+            loss = att.get("finalLoss")
+            lines.append(
+                f"{source}: {len(attempts)} finished attempt(s), last "
+                f"{att.get('status', '?')} after "
+                f"{att.get('iterations', 0)} iteration(s)"
+                + (f", final loss {loss:.4f}" if loss is not None else ""))
+    if not train:
+        lines.append("train: no convergence telemetry yet")
+    return lines
+
+
+def cmd_top(args) -> int:
+    """ISSUE 12: `pio top` — one refreshing terminal view combining the
+    serving posture (qps/p50/mode/SLO burn from /stats.json), the HBM
+    ledger by component, and train/stream convergence progress."""
+    import urllib.request
+
+    url = args.url.rstrip("/") + "/stats.json"
+    prev: tuple[float, int] | None = None
+    frames = 0
+    while True:
+        try:
+            with urllib.request.urlopen(url, timeout=10) as r:
+                stats = json.loads(r.read().decode())
+            lines = _top_frame(stats, prev)
+            prev = (time.monotonic(), int(stats.get("requestCount") or 0))
+        except OSError as e:
+            lines = [f"pio top · engine server unreachable at "
+                     f"{args.url}: {e}"]
+        if not args.once:
+            # clear + home, like top(1); plain print for --once so the
+            # frame is capturable/testable
+            print("\x1b[2J\x1b[H", end="")
+        for ln in lines:
+            _ok(ln)
+        frames += 1
+        if args.once:
+            return 0
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
 
 
 def cmd_import(args) -> int:
@@ -1310,6 +1459,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also write flight-before.json/flight-after.json "
                         "bracketing the window into this local directory")
 
+    sp = sub.add_parser("top",
+                        help="live terminal view of a deployed engine "
+                             "server: qps/p50/mode/SLO burn, the HBM "
+                             "ledger by component, train/stream progress")
+    sp.add_argument("--url", default="http://localhost:8000",
+                    help="engine server base URL "
+                         "(default http://localhost:8000)")
+    sp.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds (default 2)")
+    sp.add_argument("--once", action="store_true",
+                    help="render exactly one frame and exit (no screen "
+                         "clear) — for scripts and tests")
+
     sp = sub.add_parser("import")
     sp.add_argument("--appid", type=int, required=True)
     sp.add_argument("--channel", type=int, default=None)
@@ -1346,6 +1508,7 @@ COMMANDS = {
     "adminserver": cmd_adminserver,
     "dashboard": cmd_dashboard,
     "status": cmd_status,
+    "top": cmd_top,
     "admin": cmd_admin,
     "profile": cmd_profile,
     "import": cmd_import,

@@ -55,6 +55,30 @@ async def handle_slo(request: web.Request) -> web.Response:
     })
 
 
+async def handle_train(request: web.Request) -> web.Response:
+    """ISSUE 12: proxy the engine server's train/stream convergence and
+    device-ledger blocks — the live answer to "is this run converging
+    and what is it holding in HBM?". Same 502 contract as /slo.json."""
+    import aiohttp
+
+    base = request.query.get("url") or request.app[ENGINE_URL_KEY]
+    try:
+        timeout = aiohttp.ClientTimeout(total=5)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            async with session.get(base.rstrip("/") + "/stats.json") as r:
+                stats = await r.json()
+    except Exception as e:  # noqa: BLE001 — report, don't crash the page
+        return web.json_response(
+            {"engineUrl": base, "error": f"engine server unreachable: {e}"},
+            status=502)
+    return web.json_response({
+        "engineUrl": base,
+        "train": stats.get("train"),
+        "device": stats.get("device"),
+        "model": stats.get("model"),
+    })
+
+
 @web.middleware
 async def cors_middleware(request: web.Request, handler):
     """(reference CorsSupport.scala — allow-all CORS for dashboard XHR)"""
@@ -93,8 +117,9 @@ async def handle_index(request: web.Request) -> web.Response:
         "<th>evaluation</th><th>generator</th><th>batch</th><th>results</th></tr>"
         f"{rows}</table>"
         '<p>Serving SLO burn rates and stage waterfalls: '
-        '<a href="/slo.json">/slo.json</a> (proxied from the engine '
-        "server's /stats.json)</p></body></html>"
+        '<a href="/slo.json">/slo.json</a>; train/stream convergence and '
+        'the device HBM ledger: <a href="/train.json">/train.json</a> '
+        "(proxied from the engine server's /stats.json)</p></body></html>"
     )
     return web.Response(text=body, content_type="text/html")
 
@@ -136,6 +161,7 @@ def create_dashboard_app(
     app[ENGINE_URL_KEY] = engine_url
     app.router.add_get("/", handle_index)
     app.router.add_get("/slo.json", handle_slo)
+    app.router.add_get("/train.json", handle_train)
     app.router.add_get(
         "/engine_instances/{instance_id}/evaluator_results.txt", handle_results_txt
     )

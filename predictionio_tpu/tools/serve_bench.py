@@ -116,6 +116,28 @@ def _timed_rows(ret, q, *, batch, k, iters):
             "device_share": round(device / total, 4) if total else None}
 
 
+def _device_evidence(before: dict | None = None) -> dict:
+    """ISSUE 12: the device ledger's compile/HBM stamp for a bench row.
+    Without ``before``: the current absolute totals (a baseline).
+    With ``before``: the delta since that baseline — what THIS row's
+    retriever cost to compile and holds resident, so the r06 hardware
+    campaign carries device-side evidence alongside qps."""
+    from ..obs.device import COMPILE_HISTOGRAMS, LEDGER
+
+    cur = {
+        "compile_seconds": sum(h.snapshot()["sum"]
+                               for h in COMPILE_HISTOGRAMS.values()),
+        "hbm_bytes": LEDGER.snapshot()["totalBytes"],
+    }
+    if before is None:
+        return cur
+    return {
+        "compile_seconds": round(
+            cur["compile_seconds"] - before["compile_seconds"], 4),
+        "hbm_bytes": int(cur["hbm_bytes"] - before["hbm_bytes"]),
+    }
+
+
 def _recall_at_k(approx_idx, exact_idx) -> float:
     """Mean fraction of the exact top-k the approximate top-k recovered."""
     hits = 0
@@ -142,6 +164,7 @@ def ann_sweep(*, n_items: int = 65_536, rank: int = 64,
 
     items, q = clustered_items(n_items, rank, batch=batch, seed=seed)
 
+    dev0 = _device_evidence()
     exact = DeviceRetriever(items)
     exact.prewarm(batch_sizes=(batch,), ks=(k,))
     exact.topk(q, k)
@@ -150,9 +173,11 @@ def ann_sweep(*, n_items: int = 65_536, rank: int = 64,
              **_timed_rows(exact, q, batch=batch, k=k, iters=iters),
              "merge": "exact", "exec_cache_hit_rate":
                  EXEC_CACHE.stats()["hitRate"],
-             "batch": batch, "k": k, "n_items": n_items}
+             "batch": batch, "k": k, "n_items": n_items,
+             **_device_evidence(dev0)}
     _, exact_idx = exact.topk(q, k)
 
+    dev1 = _device_evidence()
     ann = AnnRetriever(items, nprobe=nprobe or DEFAULT_NPROBE,
                        min_items=0, seed=seed)
     ann.prewarm(batch_sizes=(batch,), ks=(k,))
@@ -165,7 +190,8 @@ def ann_sweep(*, n_items: int = 65_536, rank: int = 64,
              **_timed_rows(ann, q, batch=batch, k=k, iters=iters),
              "merge": f"ivf:{st['cells']}c/{st['lastEffectiveNprobe']}p",
              "exec_cache_hit_rate": EXEC_CACHE.stats()["hitRate"],
-             "batch": batch, "k": k, "n_items": n_items}
+             "batch": batch, "k": k, "n_items": n_items,
+             **_device_evidence(dev1)}
     return [row_e, row_a]
 
 
@@ -200,6 +226,7 @@ def sweep(ways=DEFAULT_WAYS, *, n_items: int = 65_536, rank: int = 64,
 
     rows = []
     for w, auto in resolved:
+        dev0 = _device_evidence()
         mesh = make_mesh((w,), ("model",))
         ret = ShardedDeviceRetriever(items, mesh)
         ret.prewarm(batch_sizes=(batch,), ks=(k,))
@@ -213,6 +240,7 @@ def sweep(ways=DEFAULT_WAYS, *, n_items: int = 65_536, rank: int = 64,
             "batch": batch,
             "k": k,
             "n_items": n_items,
+            **_device_evidence(dev0),
         })
     return rows
 

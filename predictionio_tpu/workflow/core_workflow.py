@@ -22,6 +22,7 @@ from ..controller.components import PersistentModel
 from ..controller.engine import Engine, TrainResult
 from ..controller.evaluation import Evaluation, MetricEvaluator, MetricEvaluatorResult
 from ..controller.params import EngineParams, params_to_json
+from ..obs.training import TRAINING
 from ..storage import EngineInstance, EvaluationInstance, Model, Storage
 from .context import Context
 from .faults import FAULTS
@@ -289,6 +290,9 @@ def run_train(
     )
     instance_id = meta.engine_instance_insert(instance)
     log.info("EngineInstance %s created; training starts", instance_id)
+    # fresh convergence channel per run: attempt summaries from a
+    # previous run in this process must not ride this instance's record
+    TRAINING.reset_source("train")
 
     def _stamp(status: str, **extra) -> EngineInstance:
         """Final status flip over the FRESHEST record, so the
@@ -342,7 +346,9 @@ def run_train(
         n_models, n_bytes = supervisor.run(_body)
         from .tracing import phase_times_json
 
-        _stamp("COMPLETED", phase_times=phase_times_json(ctx))
+        TRAINING.finish("train", "COMPLETED")
+        _stamp("COMPLETED", phase_times=phase_times_json(ctx),
+               convergence=json.dumps(TRAINING.summaries("train")))
         log.info("Training completed: instance %s (%d model(s), %d bytes, "
                  "%d attempt(s))",
                  instance_id, n_models, n_bytes, supervisor.attempts)

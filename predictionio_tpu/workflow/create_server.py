@@ -61,9 +61,11 @@ from aiohttp import web
 
 from ..controller.engine import Engine, TrainResult
 from ..controller.params import parse_params
+from ..obs.device import LEDGER
 from ..obs.flight import FLIGHT
 from ..obs.http import handle_metrics, make_trace_middleware
 from ..obs.metrics import METRICS
+from ..obs.training import TRAINING
 from ..obs.slo import SloTracker, default_objectives
 from ..obs.trace import TRACE_HEADER, ensure_request_id, trace_event
 from ..obs.waterfall import (Waterfall, mark_stage, reset_stage_sink,
@@ -847,6 +849,7 @@ class EngineServer:
             self.patch_discarded += discarded
             self.patch_epoch += 1
             _M_DELTA_EPOCH.set(self.patch_epoch)
+            self._track_patch_table_bytes()
             log.info("reload reconciled delta patches: %d discarded as "
                      "superseded, %d re-applied", discarded, len(keep))
         self.deployed = fresh  # atomic reference swap
@@ -958,6 +961,7 @@ class EngineServer:
             _M_DELTA_EPOCH.set(self.patch_epoch)
             for u in applied:
                 self.patch_table[u] = clean[u]
+            self._track_patch_table_bytes()
         return {
             "appliedCount": len(applied),
             "applied": sorted(applied),
@@ -966,6 +970,15 @@ class EngineServer:
             "dropped": {"invalid": invalid, "tableFull": table_full,
                         "rankMismatch": rank_mismatch},
         }
+
+    def _track_patch_table_bytes(self) -> None:
+        """Re-count the delta patch table's residency whole (absolute
+        set, self-healing) into the device ledger's HBM gauge — the
+        table's factor rows are the one serving-side buffer that grows
+        with traffic rather than with deployed shapes (ISSUE 12)."""
+        LEDGER.track_buffer(
+            "patch_table",
+            sum(int(v.nbytes) for v in self.patch_table.values()))
 
     def status(self) -> dict:
         inst = self.deployed.instance
@@ -1076,6 +1089,10 @@ class EngineServer:
             # ISSUE 10: streaming delta hot-patch posture
             "patches": patches_block,
             "feedback": self.feedback.stats() if self.feedback else None,
+            # ISSUE 12: the device ledger (HBM by component, compile
+            # times, padding waste) + train/stream convergence
+            "device": LEDGER.snapshot(),
+            "train": TRAINING.snapshot(),
         }
 
 

@@ -66,10 +66,13 @@ import time
 import urllib.error
 import urllib.request
 
+import numpy as np
+
 from ..controller.metric import AverageMetric
 from ..obs.breaker import breaker_set
 from ..obs.metrics import METRICS
 from ..obs.trace import TRACE_HEADER, trace_event
+from ..obs.training import TRAINING
 from .faults import FAULTS, FaultInjected
 from .supervisor import classify_error
 
@@ -303,6 +306,36 @@ class StreamingUpdater:
                           "threshold": self.eval_gate}
         return "publish" if folded >= baseline - self.eval_gate else "skip"
 
+    def _observe_convergence(self, kept_uids: list[str], factors,
+                             fold_s: float) -> None:
+        """Stream-side convergence telemetry (ISSUE 12): the mean
+        relative factor-delta norm over the batch's already-known users
+        (how hard fold-in is moving the serving factors) plus the gate's
+        holdout metric as a loss signal (1 - hit@k = holdout miss rate).
+        Pure bookkeeping — never fails the cycle."""
+        try:
+            m = self.model
+            deltas = []
+            for j, u in enumerate(kept_uids):
+                row = m.user_ids.get(u)
+                if row is None:
+                    continue
+                old = np.asarray(m.user_factors[row], np.float32)
+                denom = float(np.linalg.norm(old))
+                if denom > 0.0:
+                    deltas.append(
+                        float(np.linalg.norm(factors[j] - old)) / denom)
+            loss = None
+            gate = self.last_gate
+            if gate and gate.get("folded") is not None:
+                loss = 1.0 - float(gate["folded"])
+            TRAINING.observe(
+                "stream", self.cycles, loss=loss,
+                delta_norm=(sum(deltas) / len(deltas)) if deltas else None,
+                step_seconds=fold_s)
+        except Exception:
+            pass
+
     # -- publish path ------------------------------------------------------
     def _post(self, patches: dict[str, list[float]],
               trace: str | None) -> dict:
@@ -398,7 +431,8 @@ class StreamingUpdater:
             t0 = time.perf_counter()
             factors, kept = self.model.fold_in_users(batch,
                                                      solver=self.solver)
-            _M_FOLD.record(time.perf_counter() - t0)
+            fold_s = time.perf_counter() - t0
+            _M_FOLD.record(fold_s)
             kept_uids = [u for u, keep in zip(uids, kept) if keep]
             for u in kept_uids:
                 trace_event("stream.fold_in", trace=traces.get(u), user=u,
@@ -410,6 +444,7 @@ class StreamingUpdater:
                 continue
             decision = self._gate_decision(users, kept_uids)
             _M_GATE.inc(decision=decision)
+            self._observe_convergence(kept_uids, factors, fold_s)
             if decision == "skip":
                 self.gate_skips += 1
                 summary["gateSkipped"] += len(kept_uids)
@@ -480,4 +515,5 @@ class StreamingUpdater:
             },
             "lag": {str(k): self.follower.lag(k)
                     for k in range(self.follower.num_partitions)},
+            "convergence": TRAINING.snapshot().get("stream"),
         }

@@ -168,16 +168,22 @@ def _reset_metrics(tmp_path):
     The flight recorder (also process-wide) resets too, with its
     incident-dump directory pointed INTO the test's tmp dir — a chaos
     test tripping the watchdog must never write to ~/.pio_tpu."""
+    from predictionio_tpu.obs.device import LEDGER
     from predictionio_tpu.obs.flight import FLIGHT
     from predictionio_tpu.obs.metrics import METRICS
+    from predictionio_tpu.obs.training import TRAINING
 
     METRICS.reset()
     FLIGHT.reset()
+    LEDGER.reset()
+    TRAINING.reset()
     FLIGHT.configure(capacity=256, dump_dir=str(tmp_path / "flight"),
                      cooldown_s=30.0)
     yield
     METRICS.reset()
     FLIGHT.reset()
+    LEDGER.reset()
+    TRAINING.reset()
 
 
 @pytest.fixture(scope="session")

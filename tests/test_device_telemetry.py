@@ -1,0 +1,387 @@
+"""Device & training telemetry (ISSUE 12): the XLA cost/HBM ledger over
+the shared ExecutableCache, convergence tracking for batch ALS and the
+streaming updater, and the `pio top` terminal view.
+
+Pinned invariants (acceptance criteria):
+  * every executable the ExecutableCache holds has a ledger entry;
+  * the per-component ``pio_hbm_bytes`` gauge equals the sum of the
+    resident ledger entries' memory_analysis bytes — or the component is
+    flagged ``analysisUnavailable``;
+  * a cache evict decrements the gauge by exactly the victim's bytes
+    (and the prewarm/pin path exempts hot shapes from that eviction);
+  * ``pio top`` renders one full refresh against a live deployed server.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+import pytest
+import requests
+
+from predictionio_tpu.obs.device import LEDGER, LedgerEntry
+from predictionio_tpu.obs.metrics import METRICS
+from predictionio_tpu.obs.training import TRAINING
+from predictionio_tpu.ops.retrieval import EXEC_CACHE, ExecutableCache
+
+# ---------------------------------------------------------------------------
+# ledger <-> cache parity on real compiles
+
+
+def test_every_cache_resident_executable_has_ledger_entry(rng):
+    """Compiles landing in EXEC_CACHE during this test (prewarm + an
+    odd-shaped dispatch) must all be ledger-resident, and the
+    per-component gauge must match the summed entry bytes (or be
+    flagged analysisUnavailable)."""
+    from predictionio_tpu.ops.retrieval import DeviceRetriever
+
+    before = set(EXEC_CACHE._entries)
+    items = rng.standard_normal((517, 24)).astype(np.float32)
+    ret = DeviceRetriever(items)
+    assert ret.prewarm(batch_sizes=(1,), ks=(7,))
+    ret.topk(rng.standard_normal((3, 24)).astype(np.float32), 7)
+
+    added = set(EXEC_CACHE._entries) - before
+    assert added, "expected fresh compiles for the distinctive shapes"
+    assert added <= LEDGER.entry_keys()
+
+    gauge = METRICS.get("pio_hbm_bytes")
+    snap = LEDGER.snapshot()
+    assert snap["components"], "compiles must produce component rows"
+    for comp, c in snap["components"].items():
+        assert gauge.value(comp) == pytest.approx(c["bytes"])
+        # on this jaxlib both analyses work; the contract is bytes OR flag
+        assert c["bytes"] > 0 or c["analysisUnavailable"]
+    assert snap["totalBytes"] == sum(
+        c["bytes"] for c in snap["components"].values())
+    assert snap["watermarkBytes"] >= snap["totalBytes"]
+    # compile-time histograms saw the builds
+    assert sum(h["count"] for h in snap["compile"].values()) >= len(added)
+
+
+def test_fold_in_solver_compiles_through_the_shared_cache(rng):
+    """The ALS device fold-in program now rides EXEC_CACHE (not its own
+    module-level dict) — its executable gets a fold_in ledger entry and
+    a fold_in compile-histogram observation."""
+    from predictionio_tpu.models.als import ALSConfig, ALSModel
+    from predictionio_tpu.storage.bimap import BiMap
+
+    rank, ni = 5, 37
+    m = ALSModel(
+        user_factors=rng.standard_normal((4, rank)).astype(np.float32),
+        item_factors=rng.standard_normal((ni, rank)).astype(np.float32),
+        user_ids=BiMap({f"u{i}": i for i in range(4)}),
+        item_ids=BiMap({f"i{i}": i for i in range(ni)}),
+        config=ALSConfig(rank=rank, lambda_=0.1, alpha=2.0,
+                         implicit_prefs=False),
+    )
+    batch = [(["i0", "i3"], [4.0, 2.0]), (["i1"], [5.0])]
+    dev, kept_d = m.fold_in_users(batch, solver="device")
+    host, kept_h = m.fold_in_users(batch, solver="host")
+    np.testing.assert_array_equal(kept_d, kept_h)
+    np.testing.assert_allclose(dev, host, atol=1e-4)
+
+    fold_keys = [k for k in EXEC_CACHE._entries
+                 if k[0] == "fold_in" and k[1] == rank]
+    assert fold_keys
+    assert set(fold_keys) <= LEDGER.entry_keys()
+    hist = METRICS.get("pio_xla_compile_fold_in_seconds")
+    assert hist.snapshot()["count"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# evict/pin accounting on a private cache with known byte sizes
+
+
+class _FakeMem:
+    def __init__(self, arg, out, temp, code):
+        self.argument_size_in_bytes = arg
+        self.output_size_in_bytes = out
+        self.temp_size_in_bytes = temp
+        self.generated_code_size_in_bytes = code
+
+
+class _FakeExe:
+    """Stands in for a jax Compiled: known analysis numbers, no device."""
+
+    def __init__(self, nbytes, flops=10.0):
+        self._nbytes = nbytes
+        self._flops = flops
+
+    def cost_analysis(self):
+        return {"flops": self._flops, "bytes accessed": 2.0 * self._nbytes}
+
+    def memory_analysis(self):
+        return _FakeMem(self._nbytes, 0, 0, 0)
+
+
+class _DarkExe:
+    """An executable whose analyses raise (cpu jaxlib without the
+    introspection APIs) — must flag, never crash."""
+
+    def cost_analysis(self):
+        raise NotImplementedError
+
+    def memory_analysis(self):
+        raise NotImplementedError
+
+
+def test_evict_decrements_hbm_gauge_and_pin_survives():
+    cache = ExecutableCache(maxsize=2)
+    gauge = METRICS.get("pio_hbm_bytes")
+
+    cache.get_or_build(("xla", "hot"), lambda: (_FakeExe(1000), False))
+    cache.pin(("xla", "hot"))
+    cache.get_or_build(("xla", "b"), lambda: (_FakeExe(300), False))
+    assert gauge.value("xla") == pytest.approx(1300)
+    watermark = METRICS.get("pio_hbm_watermark_bytes").value()
+    assert watermark == pytest.approx(1300)
+
+    # third insert evicts the only unpinned entry ("b"), never "hot"
+    cache.get_or_build(("xla", "c"), lambda: (_FakeExe(40), False))
+    assert ("xla", "b") not in cache._entries
+    assert ("xla", "b") not in LEDGER.entry_keys()
+    assert ("xla", "hot") in cache._entries
+    assert gauge.value("xla") == pytest.approx(1000 + 40)
+    # watermark is a high-water mark: eviction must not lower it
+    assert METRICS.get("pio_hbm_watermark_bytes").value() == pytest.approx(1300)
+
+    # a cache hit must not double-count
+    cache.get_or_build(("xla", "hot"), lambda: (_FakeExe(9999), False))
+    assert gauge.value("xla") == pytest.approx(1040)
+
+
+def test_analysis_unavailable_flags_without_crashing():
+    cache = ExecutableCache(maxsize=4)
+    before = METRICS.get("pio_xla_analysis_unavailable_total").value()
+    cache.get_or_build(("ann", "dark"), lambda: (_DarkExe(), False))
+    after = METRICS.get("pio_xla_analysis_unavailable_total").value()
+    assert after == before + 1
+    snap = LEDGER.snapshot()
+    assert snap["components"]["ann"]["analysisUnavailable"] is True
+    assert snap["components"]["ann"]["bytes"] == 0
+    assert ("ann", "dark") in LEDGER.entry_keys()
+
+
+def test_unknown_key_namespace_lands_in_other_component():
+    cache = ExecutableCache(maxsize=4)
+    cache.get_or_build(("mystery", 1), lambda: (_FakeExe(64), False))
+    assert METRICS.get("pio_hbm_bytes").value("other") == pytest.approx(64)
+    hist = METRICS.get("pio_xla_compile_other_seconds")
+    assert hist.snapshot()["count"] == 1
+
+
+def test_track_buffer_is_absolute_and_shows_in_snapshot():
+    LEDGER.track_buffer("patch_table", 2048)
+    LEDGER.track_buffer("patch_table", 512)  # re-count, not accumulate
+    gauge = METRICS.get("pio_hbm_bytes")
+    assert gauge.value("patch_table") == pytest.approx(512)
+    snap = LEDGER.snapshot()
+    assert snap["components"]["patch_table"]["bytes"] == 512
+    # the 2048 peak is retained as the watermark
+    assert snap["watermarkBytes"] >= 2048
+
+
+# ---------------------------------------------------------------------------
+# padding waste
+
+
+def test_padding_waste_ratio_unit():
+    """Satellite contract: a batch of 3 padded to 64 wastes ~61/64 of
+    the dispatch; a full bucket records 0."""
+    LEDGER.record_padding_waste(3, 64)
+    h = METRICS.get("pio_dispatch_padding_waste_ratio")
+    s1 = h.snapshot()
+    assert s1["count"] == 1
+    assert s1["sum"] == pytest.approx(61 / 64)
+    LEDGER.record_padding_waste(64, 64)
+    s2 = h.snapshot()
+    assert s2["count"] == 2
+    assert s2["sum"] == pytest.approx(61 / 64)  # 0.0 added nothing
+
+
+def test_dispatch_records_padding_waste(rng):
+    """Every retriever topk funnels through _dispatch_topk: a 3-row
+    batch pads to the 8-row floor, wasting 5/8 of the dispatch."""
+    from predictionio_tpu.ops.retrieval import DeviceRetriever
+
+    items = rng.standard_normal((300, 16)).astype(np.float32)
+    ret = DeviceRetriever(items)
+    h = METRICS.get("pio_dispatch_padding_waste_ratio")
+    before = h.snapshot()
+    ret.topk(rng.standard_normal((3, 16)).astype(np.float32), 5)
+    after = h.snapshot()
+    assert after["count"] == before["count"] + 1
+    assert after["sum"] - before["sum"] == pytest.approx(5 / 8)
+
+
+# ---------------------------------------------------------------------------
+# convergence tracking
+
+
+def test_train_als_records_convergence_history(rng):
+    from predictionio_tpu.models.als import ALSConfig, train_als
+    from predictionio_tpu.storage.frame import Ratings
+
+    n = 120
+    users = [f"u{i % 12}" for i in range(n)]
+    items = [f"i{i % 30}" for i in range(n)]
+    vals = rng.uniform(1, 5, size=n).astype(np.float32)
+    ratings = Ratings.from_triples(users, items, vals)
+    config = ALSConfig(rank=4, iterations=3, lambda_=0.1)
+    train_als(ratings, config)
+
+    snap = TRAINING.snapshot()["train"]
+    live = snap["live"]
+    assert live is not None and live["totalIterations"] == 3
+    assert live["iterations"] == 3
+    last = live["history"][-1]
+    assert last["loss"] > 0 and last["stepSeconds"] > 0
+    assert "deltaNorm" in last
+    # gauges track the latest observation
+    assert METRICS.get("pio_train_convergence_iteration").value("train") == 2.0
+    assert METRICS.get("pio_train_convergence_loss").value(
+        "train") == pytest.approx(last["loss"])
+
+
+def test_tracker_summarizes_attempts():
+    TRAINING.begin("train", total_iterations=2)
+    TRAINING.observe("train", 0, loss=1.0, delta_norm=0.5, step_seconds=0.1)
+    TRAINING.observe("train", 1, loss=0.4, delta_norm=0.1, step_seconds=0.3)
+    TRAINING.finish("train", "COMPLETED")
+    (att,) = TRAINING.summaries("train")
+    assert att["status"] == "COMPLETED"
+    assert att["iterations"] == 2
+    assert att["firstLoss"] == 1.0 and att["finalLoss"] == 0.4
+    assert att["finalDeltaNorm"] == 0.1
+    assert att["meanStepSeconds"] == pytest.approx(0.2)
+    # an unfinished successor is finalized as superseded by begin()
+    TRAINING.begin("train")
+    TRAINING.observe("train", 0, loss=2.0)
+    TRAINING.begin("train")
+    statuses = [a["status"] for a in TRAINING.summaries("train")]
+    assert statuses == ["COMPLETED", "superseded"]
+
+
+def test_run_train_stamps_convergence_on_instance():
+    """core_workflow stamps ConvergenceTracker.summaries('train') into
+    EngineInstance.convergence at the COMPLETED flip (valid JSON even
+    for algorithms that emit no telemetry)."""
+    from tests.test_resilience import _trained
+
+    _, inst = _trained()
+    assert inst.status == "COMPLETED"
+    assert isinstance(json.loads(inst.convergence), list)
+
+
+def test_engine_instance_convergence_roundtrip_and_status_print(capsys):
+    from predictionio_tpu.storage import Storage
+    from predictionio_tpu.storage.metadata import EngineInstance
+    from predictionio_tpu.tools.cli import main
+
+    s = Storage.get_metadata()
+    iid = s.engine_instance_insert(EngineInstance(
+        status="COMPLETED",
+        phase_times=json.dumps([["train", 1.5], ["persist", 0.1]]),
+        convergence=json.dumps([{
+            "status": "COMPLETED", "iterations": 4, "totalIterations": 4,
+            "finalLoss": 0.5, "firstLoss": 0.9, "finalDeltaNorm": 0.01,
+            "meanStepSeconds": 0.025,
+        }]),
+    ))
+    got = s.engine_instance_get(iid)
+    assert json.loads(got.convergence)[0]["finalLoss"] == 0.5
+
+    assert main(["status"]) == 0
+    out = capsys.readouterr().out
+    assert "convergence attempt 0: 4 iteration(s)" in out
+    assert "final loss 0.5000" in out
+    assert "mean step 25.0ms" in out
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder incidents embed the ledger brief
+
+
+def test_incident_dump_embeds_device_ledger_brief():
+    from predictionio_tpu.obs.flight import FLIGHT
+
+    entry = LedgerEntry(key=("xla", "big"), kind="xla",
+                        compile_seconds=0.2, argument_bytes=4096)
+    LEDGER.admit(entry)
+    path = FLIGHT.incident("telemetry_test", force=True)
+    assert path and os.path.exists(path)
+    with open(path) as f:
+        payload = json.load(f)
+    brief = payload["deviceLedger"]
+    assert brief["totalBytes"] == 4096
+    assert brief["watermarkBytes"] >= 4096
+    assert brief["topExecutables"][0]["kind"] == "xla"
+    assert brief["topExecutables"][0]["totalBytes"] == 4096
+
+
+# ---------------------------------------------------------------------------
+# pio top against a live deployed server (acceptance)
+
+
+def test_pio_top_renders_one_refresh_against_live_server(capsys, rng):
+    from predictionio_tpu.tools.cli import main
+    from predictionio_tpu.workflow.create_server import (
+        EngineServer,
+        create_engine_server_app,
+    )
+    from tests.helpers import ServerThread
+    from tests.test_resilience import _trained
+
+    # seed the process-wide telemetry the frame renders: an executable in
+    # the ledger, a padded dispatch, and a finished training attempt
+    from predictionio_tpu.ops.retrieval import DeviceRetriever
+
+    items = rng.standard_normal((256, 8)).astype(np.float32)
+    DeviceRetriever(items).topk(
+        rng.standard_normal((3, 8)).astype(np.float32), 5)
+
+    engine, inst = _trained()
+    # seed AFTER run_train: the workflow resets the "train" source at start
+    TRAINING.begin("train", total_iterations=2)
+    TRAINING.observe("train", 1, loss=0.7, delta_norm=0.2, step_seconds=0.05)
+    TRAINING.finish("train")
+    server = EngineServer(engine, inst, batch_window_ms=0.5)
+    st = ServerThread(lambda: create_engine_server_app(server))
+    try:
+        # the live endpoint carries the new device/train blocks
+        stats = requests.get(st.url + "/stats.json", timeout=10).json()
+        assert "components" in stats["device"]
+        assert stats["device"]["totalBytes"] > 0
+        assert "train" in stats
+
+        # the dashboard's /train.json proxies the same blocks
+        from predictionio_tpu.tools.dashboard import create_dashboard_app
+
+        dash = ServerThread(lambda: create_dashboard_app(st.url))
+        try:
+            body = requests.get(dash.url + "/train.json", timeout=10).json()
+            assert body["engineUrl"] == st.url
+            assert body["device"]["totalBytes"] == stats["device"]["totalBytes"]
+            assert body["train"]["train"]["attempts"]
+        finally:
+            dash.stop()
+
+        assert main(["top", "--url", st.url, "--once"]) == 0
+    finally:
+        st.stop()
+    out = capsys.readouterr().out
+    assert "pio top" in out
+    assert "slo:" in out
+    assert "hbm ledger: total" in out
+    assert "padding waste:" in out
+    assert "finished attempt(s)" in out
+
+
+def test_pio_top_once_survives_unreachable_server(capsys):
+    from predictionio_tpu.tools.cli import main
+
+    assert main(["top", "--url", "http://127.0.0.1:9", "--once"]) == 0
+    assert "unreachable" in capsys.readouterr().out
