@@ -69,6 +69,7 @@ class FlightRecorder:
         self.last_dump_reason: str | None = None
         self.dumps = 0
         self._context_fn = None
+        self._incident_listeners: list = []
 
     # -- configuration -----------------------------------------------
     def configure(self, *, capacity: int | None = None,
@@ -93,6 +94,14 @@ class FlightRecorder:
         inflight); called at record and dump time, exceptions swallowed —
         observability must never take the server down."""
         self._context_fn = fn
+
+    def add_incident_listener(self, fn) -> None:
+        """``fn(reason, path)`` runs after every non-suppressed incident
+        dump (ISSUE 13: the capture ring flushes its golden traffic the
+        moment something goes wrong — the requests that led into the
+        incident are exactly the ones worth keeping). Exceptions are
+        swallowed; listeners are cleared by :meth:`reset`."""
+        self._incident_listeners.append(fn)
 
     def _context(self) -> dict:
         fn = self._context_fn
@@ -178,13 +187,24 @@ class FlightRecorder:
                 json.dump(payload, f, indent=2, default=str)
             os.replace(tmp, path)
         except OSError:
-            return None  # a full disk must not take serving down
+            # a full disk must not take serving down — but the incident
+            # still happened, so listeners (capture flush) still run
+            self._notify_incident(reason, None)
+            return None
         with self._lock:
             self.last_dump_path = path
             self.last_dump_reason = reason
             self.dumps += 1
         _C_DUMPS.inc(reason=reason)
+        self._notify_incident(reason, path)
         return path
+
+    def _notify_incident(self, reason: str, path: str | None) -> None:
+        for fn in list(self._incident_listeners):
+            try:
+                fn(reason, path)
+            except Exception:  # noqa: BLE001 — observability never kills
+                pass
 
     # -- views ---------------------------------------------------------
     def stats(self) -> dict:
@@ -208,6 +228,7 @@ class FlightRecorder:
             self.last_dump_path = None
             self.last_dump_reason = None
             self.dumps = 0
+            self._incident_listeners.clear()
             _G_RECORDS.set(0)
 
 

@@ -1,0 +1,374 @@
+"""Deterministic replay + shadow diff over captured golden traffic.
+
+ISSUE 13, the verification half of the capture/replay harness
+(obs/capture.py records; this module re-issues and diffs):
+
+- ``replay_records()`` re-issues captured queries against a LIVE server
+  (``pio replay <capture> --target URL``) or an in-process engine
+  (``--engine-instance-id``) and classifies every answer pair at three
+  tiers, strictest first:
+
+  1. **bitwise** — identical payload: same item ids in the same order
+     with float-identical scores (JSON round-trip equality). The parity
+     a refactor must hold to call itself a refactor.
+  2. **topk_set** — the same item SET, but order or scores moved: a
+     tie-break or reduction-order change, not a wrong answer.
+  3. **score_tol** — the score ladder matches within tolerance but the
+     items differ: equivalently-scored alternatives swapped in (ANN
+     probe order, quantization). Worth eyes, rarely a bug.
+  4. **mismatch** — none of the above: the answers genuinely differ
+     (e.g. a delta patch moved this user's factors).
+
+  The report keys every mismatch by its request and by the provenance
+  delta between capture time and replay time, so "what changed" reads
+  straight off the report (patch epoch bump, different blob sha, ...).
+
+- ``ShadowMirror`` mirrors sampled LIVE traffic to a second instance
+  (``pio deploy --shadow-target URL``) and publishes the same tier
+  classification as online metrics (``pio_shadow_diff_total{tier}``,
+  ``pio_shadow_lag_seconds``). Fire-and-forget through the same
+  bounded-tracked-task discipline as ``workflow/feedback.py``'s
+  FeedbackPublisher: one shared ClientSession, every task tracked and
+  awaited at drain, a hard in-flight bound that DROPS (counted) instead
+  of queueing — the mirror can never slow or wedge the primary.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import random
+import time
+import urllib.request
+
+from .metrics import METRICS
+from .trace import TRACE_HEADER
+
+log = logging.getLogger("predictionio_tpu.replay")
+
+__all__ = ["diff_tier", "replay_records", "ShadowMirror",
+           "PROVENANCE_HEADER", "TIERS"]
+
+#: compact-JSON provenance envelope stamped on every serving response
+#: (workflow/create_server.py) — replay reads it back from live targets
+PROVENANCE_HEADER = "X-PIO-Provenance"
+
+TIERS = ("bitwise", "topk_set", "score_tol", "mismatch", "error")
+
+_M_SHADOW_DIFF = METRICS.counter(
+    "pio_shadow_diff_total",
+    "shadow-mirrored responses by diff tier vs the primary "
+    "(bitwise/topk_set/score_tol/mismatch/error)",
+    labelnames=("tier",))
+_M_SHADOW_LAG = METRICS.gauge(
+    "pio_shadow_lag_seconds",
+    "latest shadow response time measured from the primary's answer "
+    "(how far the shadow trails live traffic)")
+_M_SHADOW = METRICS.counter(
+    "pio_shadow_mirrored_total",
+    "shadow mirror decisions (mirrored/sampled_out/dropped)",
+    labelnames=("outcome",))
+
+
+# -- diffing ---------------------------------------------------------------
+
+def _item_scores(payload) -> list[tuple[object, float]] | None:
+    """Extract an ordered ``[(item, score), ...]`` ranking from a
+    serving payload. Understands the ``itemScores`` convention the
+    recommendation templates serve; returns None for anything else (the
+    differ falls back to whole-payload equality)."""
+    if not isinstance(payload, dict):
+        return None
+    rows = payload.get("itemScores")
+    if not isinstance(rows, list):
+        return None
+    out = []
+    for row in rows:
+        if not isinstance(row, dict) or "score" not in row:
+            return None
+        item = row.get("item", row.get("id"))
+        try:
+            out.append((item, float(row["score"])))
+        except (TypeError, ValueError):
+            return None
+    return out
+
+
+def diff_tier(captured, replayed, score_tol: float = 1e-6) -> str:
+    """Classify one captured/replayed response pair into the strictest
+    matching tier (see module docstring)."""
+    if captured == replayed:
+        return "bitwise"
+    a, b = _item_scores(captured), _item_scores(replayed)
+    if a is None or b is None:
+        return "mismatch"  # opaque payloads that differ at all differ
+    if a == b:
+        return "bitwise"  # rankings identical; some other field moved
+    if {i for i, _ in a} == {i for i, _ in b}:
+        return "topk_set"
+    if len(a) == len(b) and all(
+            abs(sa - sb) <= score_tol * max(1.0, abs(sa))
+            for (_, sa), (_, sb) in zip(a, b)):
+        return "score_tol"
+    return "mismatch"
+
+
+def _provenance_delta(captured: dict | None,
+                      replayed: dict | None) -> dict:
+    """Field-level diff of two provenance envelopes:
+    ``{field: {"captured": x, "replayed": y}}`` for every field that
+    moved — the "what changed between capture and replay" answer."""
+    captured, replayed = captured or {}, replayed or {}
+    delta = {}
+    for key in sorted(set(captured) | set(replayed)):
+        if captured.get(key) != replayed.get(key):
+            delta[key] = {"captured": captured.get(key),
+                          "replayed": replayed.get(key)}
+    return delta
+
+
+# -- replay ----------------------------------------------------------------
+
+def _http_issue(target: str, timeout_s: float):
+    """Issuer re-POSTing each captured query to a live ``target`` —
+    returns ``(response, provenance, ok)``; provenance comes back off
+    the X-PIO-Provenance response header."""
+    base = target.rstrip("/")
+
+    def issue(record: dict):
+        req = urllib.request.Request(
+            f"{base}/queries.json",
+            data=json.dumps(record["request"]).encode(),
+            headers={"Content-Type": "application/json",
+                     TRACE_HEADER: f"replay-{record.get('rid', '')}"},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            body = json.loads(resp.read().decode())
+            prov_hdr = resp.headers.get(PROVENANCE_HEADER)
+        prov = None
+        if prov_hdr:
+            try:
+                prov = json.loads(prov_hdr)
+            except json.JSONDecodeError:
+                prov = None
+        return body, prov, True
+
+    return issue
+
+
+def _server_issue(server):
+    """Issuer dispatching each captured query through an in-process
+    ``EngineServer`` (no HTTP): the `pio replay --engine-instance-id`
+    path, same rehydrated-bundle serving `pio batchpredict` uses."""
+
+    def issue(record: dict):
+        body = server.serve_query(record["request"])
+        return body, server.provenance(), True
+
+    return issue
+
+
+def replay_records(records, *, target: str | None = None, server=None,
+                   score_tol: float = 1e-6, timeout_s: float = 10.0,
+                   mismatch_cap: int = 256) -> dict:
+    """Re-issue captured traffic and produce the parity/latency report.
+
+    ``records``: iterable of capture dicts (obs/capture.iter_capture).
+    Exactly one of ``target`` (live server base URL) or ``server``
+    (in-process EngineServer) must be given. Only records captured with
+    HTTP status 200 are replayed — error answers aren't parity targets.
+    """
+    if (target is None) == (server is None):
+        raise ValueError("replay needs exactly one of target= or server=")
+    issue = _http_issue(target, timeout_s) if target else _server_issue(server)
+    tiers = {t: 0 for t in TIERS}
+    mismatches: list[dict] = []
+    captured_ms: list[float] = []
+    replayed_ms: list[float] = []
+    replay_prov: dict | None = None
+    capture_prov: dict | None = None
+    skipped = total = 0
+    for rec in records:
+        if not isinstance(rec.get("request"), dict) \
+                or rec.get("status", 200) != 200:
+            skipped += 1
+            continue
+        total += 1
+        if capture_prov is None and isinstance(rec.get("provenance"), dict):
+            capture_prov = rec["provenance"]
+        t0 = time.perf_counter()
+        try:
+            body, prov, _ok = issue(rec)
+        except Exception as e:  # noqa: BLE001 — report, don't die mid-run
+            tiers["error"] += 1
+            if len(mismatches) < mismatch_cap:
+                mismatches.append({"rid": rec.get("rid"),
+                                   "tier": "error",
+                                   "request": rec["request"],
+                                   "error": f"{type(e).__name__}: {e}"})
+            continue
+        replayed_ms.append((time.perf_counter() - t0) * 1e3)
+        if isinstance(rec.get("latencyMs"), (int, float)):
+            captured_ms.append(float(rec["latencyMs"]))
+        if prov is not None:
+            replay_prov = prov
+        # the feedback loop decorates live answers with a prId the
+        # replay target won't reproduce — strip it on both sides
+        tier = diff_tier(_strip_volatile(rec.get("response")),
+                         _strip_volatile(body), score_tol)
+        tiers[tier] += 1
+        if tier != "bitwise" and len(mismatches) < mismatch_cap:
+            mismatches.append({
+                "rid": rec.get("rid"),
+                "tier": tier,
+                "request": rec["request"],
+                "captured": rec.get("response"),
+                "replayed": body,
+                "provenanceDelta": _provenance_delta(
+                    rec.get("provenance"), prov),
+            })
+    return {
+        "total": total,
+        "skipped": skipped,
+        "tiers": tiers,
+        "parityPct": round(100.0 * tiers["bitwise"] / total, 3) if total else None,
+        "scoreTol": score_tol,
+        "latencyMs": {"captured": _p50(captured_ms),
+                      "replayed": _p50(replayed_ms)},
+        "provenance": {
+            "captured": capture_prov,
+            "replayed": replay_prov,
+            "delta": _provenance_delta(capture_prov, replay_prov),
+        },
+        "mismatches": mismatches,
+    }
+
+
+def _strip_volatile(payload):
+    """Drop per-request fields no replay can reproduce (the feedback
+    prId is minted fresh per serve)."""
+    if isinstance(payload, dict) and "prId" in payload:
+        return {k: v for k, v in payload.items() if k != "prId"}
+    return payload
+
+
+def _p50(xs: list[float]) -> float | None:
+    if not xs:
+        return None
+    s = sorted(xs)
+    return round(s[len(s) // 2], 3)
+
+
+# -- shadow mirror ---------------------------------------------------------
+
+class ShadowMirror:
+    """Mirror sampled live traffic to a second instance, diff online.
+
+    The FeedbackPublisher discipline, minus the retry queue (a shadow
+    answer is only meaningful NOW — replaying it later would diff stale
+    traffic against a moved target): one shared session, tracked tasks
+    cancelled+awaited at drain, a hard in-flight bound that drops
+    (counted) rather than queues. ``mirror()`` is synchronous and
+    allocation-light; everything slow happens inside the task.
+    """
+
+    def __init__(self, target: str, *, sample: float = 1.0,
+                 max_inflight: int = 64, timeout_s: float = 5.0,
+                 score_tol: float = 1e-6):
+        self.target = target.rstrip("/")
+        self.sample = min(1.0, max(0.0, float(sample)))
+        self.max_inflight = max(1, int(max_inflight))
+        self.timeout_s = timeout_s
+        self.score_tol = score_tol
+        self._rng = random.Random()
+        self._session = None
+        self._tasks: set[asyncio.Task] = set()
+        self._closing = False
+        self.mirrored = 0
+        self.dropped = 0
+        self.tiers = {t: 0 for t in TIERS}
+
+    # -- hot path ----------------------------------------------------------
+    def mirror(self, query_json: dict, primary_response, rid: str) -> None:
+        """Fire-and-forget mirror of one served query. Never blocks the
+        caller: over the in-flight bound (shadow slower than primary),
+        the sample is dropped and counted."""
+        if self._closing:
+            return
+        if self.sample < 1.0 and self._rng.random() >= self.sample:
+            _M_SHADOW.inc(outcome="sampled_out")
+            return
+        if len(self._tasks) >= self.max_inflight:
+            self.dropped += 1
+            _M_SHADOW.inc(outcome="dropped")
+            return
+        task = asyncio.create_task(
+            self._mirror_one(query_json, primary_response, rid,
+                             time.monotonic()))
+        self._tasks.add(task)
+        task.add_done_callback(self._task_done)
+
+    def _task_done(self, task: asyncio.Task) -> None:
+        self._tasks.discard(task)
+        if task.cancelled():
+            return
+        exc = task.exception()  # retrieve: a lost exception logs nothing
+        if exc is not None:
+            log.warning("shadow mirror task died: %s", exc)
+
+    async def _ensure_session(self):
+        import aiohttp
+
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.timeout_s))
+        return self._session
+
+    async def _mirror_one(self, query_json: dict, primary, rid: str,
+                          t0: float) -> None:
+        try:
+            session = await self._ensure_session()
+            async with session.post(
+                f"{self.target}/queries.json", json=query_json,
+                headers={TRACE_HEADER: f"shadow-{rid}"},
+            ) as resp:
+                body = await resp.json()
+                ok = resp.status == 200
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 — an unreachable shadow is a tier
+            self.tiers["error"] += 1
+            _M_SHADOW_DIFF.inc(tier="error")
+            return
+        _M_SHADOW_LAG.set(time.monotonic() - t0)
+        tier = (diff_tier(_strip_volatile(primary), _strip_volatile(body),
+                          self.score_tol) if ok else "error")
+        self.mirrored += 1
+        self.tiers[tier] += 1
+        _M_SHADOW.inc(outcome="mirrored")
+        _M_SHADOW_DIFF.inc(tier=tier)
+
+    # -- lifecycle ---------------------------------------------------------
+    async def aclose(self) -> None:
+        """Drain-time teardown: cancel + await every tracked task, close
+        the shared session. Idempotent."""
+        self._closing = True
+        tasks, self._tasks = set(self._tasks), set()
+        for t in tasks:
+            t.cancel()
+        if tasks:
+            await asyncio.gather(*tasks, return_exceptions=True)
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+        self._session = None
+
+    def stats(self) -> dict:
+        return {
+            "target": self.target,
+            "sample": self.sample,
+            "mirrored": self.mirrored,
+            "dropped": self.dropped,
+            "inflight": len(self._tasks),
+            "tiers": dict(self.tiers),
+        }

@@ -98,7 +98,8 @@ _M_PART_FILL = METRICS.gauge(
 log = logging.getLogger("predictionio_tpu.journal")
 
 __all__ = ["EventJournal", "PartitionedJournal", "JournalFollower",
-           "JournalFull", "JournalLayoutError", "FSYNC_POLICIES"]
+           "JournalFull", "JournalLayoutError", "FSYNC_POLICIES",
+           "iter_journal_records"]
 
 _HEADER = struct.Struct("<II")  # (payload length, crc32(payload))
 _SEGMENT_GLOB = "journal-*.log"
@@ -154,6 +155,30 @@ def _segment_name(seq: int) -> str:
 
 def _segment_seq(path: Path) -> int:
     return int(path.name[len("journal-"):-len(".log")])
+
+
+def iter_journal_records(directory: str | os.PathLike):
+    """Yield every valid record payload under ``directory``, oldest
+    first — a pure read-only scan (ISSUE 13: the capture/replay layer's
+    view of a capture journal). Unlike ``JournalFollower`` this carries
+    no cursor at all: every segment's longest valid record prefix is
+    read in seq order, torn tails and vanished segments are skipped
+    (never fatal), and nothing on disk is touched."""
+    for path in sorted(Path(directory).glob(_SEGMENT_GLOB),
+                       key=_segment_seq):
+        try:
+            with open(path, "rb") as fh:
+                while True:
+                    header = fh.read(_HEADER.size)
+                    if len(header) < _HEADER.size:
+                        break
+                    length, crc = _HEADER.unpack(header)
+                    payload = fh.read(length)
+                    if len(payload) < length or zlib.crc32(payload) != crc:
+                        break  # torn tail: keep the valid prefix only
+                    yield payload
+        except OSError:
+            continue  # segment GC'd mid-scan: the rest still reads
 
 
 class _Segment:

@@ -19,6 +19,7 @@ import logging
 
 from aiohttp import web
 
+from ..obs.http import make_trace_middleware
 from ..storage import Storage
 
 log = logging.getLogger("predictionio_tpu.admin")
@@ -122,7 +123,8 @@ async def handle_app_data_delete(request: web.Request) -> web.Response:
 
 
 def create_admin_app() -> web.Application:
-    app = web.Application()
+    # ISSUE 13: trace ids on every surface, admin included
+    app = web.Application(middlewares=[make_trace_middleware()])
     app.router.add_get("/", handle_root)
     app.router.add_get("/cmd/app", handle_app_list)
     app.router.add_post("/cmd/app", handle_app_new)

@@ -24,6 +24,8 @@ console/Console.scala:128-1245). Same verb set, no JVM/spark-submit spawning
   pio status | version
   pio admin reap [--stale-after-s N] [--dry-run]
   pio admin metrics [--json]
+  pio capture start|stop [--url U] | export DIR --output F
+  pio replay CAPTURE_DIR [--target URL | --engine-instance-id ID]
 
 Engine directory convention (replacing the reference's sbt build + jar
 manifest): an engine dir holds ``engine.json`` whose ``engineFactory``
@@ -496,6 +498,12 @@ def cmd_deploy(args) -> int:
         slo_latency_ms=args.slo_latency_ms,
         flight_capacity=args.flight_capacity,
         flight_dump_dir=args.flight_dir,
+        capture_dir=args.capture_dir,
+        capture_sample=args.capture_sample,
+        capture_ring=args.capture_ring,
+        capture_max_mb=args.capture_max_mb,
+        shadow_target=args.shadow_target,
+        shadow_sample=args.shadow_sample,
     )
     return 0
 
@@ -832,6 +840,92 @@ def cmd_profile(args) -> int:
             p = out / f"flight-{stem}.json"
             p.write_text(json.dumps(body.get(key), indent=2))
             _ok(f"  wrote {p}")
+    return 0
+
+
+def cmd_capture(args) -> int:
+    """``pio capture start|stop`` toggles a live server's golden-traffic
+    recording (POST /capture/{start,stop} — stop flushes the ring);
+    ``pio capture export`` rewrites a local capture journal as JSONL."""
+    if args.capture_command == "export":
+        from ..obs.capture import export_capture
+
+        if not Path(args.dir).is_dir():
+            _die(f"capture directory {args.dir!r} not found")
+        n = export_capture(args.dir, args.output)
+        _ok(f"Exported {n} captured record(s) -> {args.output}")
+        return 0
+    import urllib.request
+
+    url = f"{args.url.rstrip('/')}/capture/{args.capture_command}"
+    req = urllib.request.Request(url, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            body = json.loads(r.read().decode())
+    except OSError as e:
+        _die(f"capture {args.capture_command} failed against {args.url}: "
+             f"{e}")
+    _ok(body.get("message", ""))
+    cap = body.get("capture") or {}
+    if cap:
+        _ok(f"  dir={cap.get('directory')} captured={cap.get('captured')} "
+            f"onDisk={cap.get('journalRecords')} "
+            f"bytes={cap.get('journalBytes')}")
+    return 0
+
+
+def cmd_replay(args) -> int:
+    """``pio replay <capture-dir>`` re-issues captured golden traffic
+    and prints the three-tier parity report (obs/replay.py). Target is
+    either a live server (``--target URL``) or an in-process rehydration
+    of an engine instance (``--engine-instance-id`` / latest COMPLETED),
+    the same no-HTTP path `pio batchpredict` serves from."""
+    from ..obs.capture import iter_capture
+    from ..obs.replay import replay_records
+
+    if not Path(args.capture_dir).is_dir():
+        _die(f"capture directory {args.capture_dir!r} not found")
+    records = list(iter_capture(args.capture_dir))
+    if not records:
+        _die(f"no readable capture records under {args.capture_dir!r}")
+    if args.target:
+        report = replay_records(records, target=args.target,
+                                score_tol=args.score_tol)
+    else:
+        _enable_compile_cache()
+        from ..workflow.create_server import EngineServer
+
+        engine_dir, engine, inst = _resolve_engine_instance(args)
+        server = EngineServer(
+            engine, inst, engine_dir=engine_dir,
+            batch_window_ms=0,  # offline: no micro-batcher
+            fallback=not args.engine_instance_id,
+            retrieval=_retrieval_params(engine_dir, args))
+        report = replay_records(records, server=server,
+                                score_tol=args.score_tol)
+    if args.json:
+        print(json.dumps(report, indent=2, default=str))
+        return 0
+    t = report["tiers"]
+    _ok(f"Replayed {report['total']} record(s) "
+        f"({report['skipped']} skipped): parity {report['parityPct']}%")
+    _ok(f"  tiers: bitwise={t['bitwise']} topk_set={t['topk_set']} "
+        f"score_tol={t['score_tol']} mismatch={t['mismatch']} "
+        f"error={t['error']}")
+    lat = report["latencyMs"]
+    _ok(f"  p50 latency ms: captured={lat['captured']} "
+        f"replayed={lat['replayed']}")
+    delta = report["provenance"]["delta"]
+    if delta:
+        _ok("  provenance delta (capture -> replay):")
+        for field, pair in delta.items():
+            _ok(f"    {field}: {pair['captured']!r} -> "
+                f"{pair['replayed']!r}")
+    else:
+        _ok("  provenance identical between capture and replay")
+    for m in report["mismatches"][:args.show_mismatches]:
+        _ok(f"  [{m['tier']}] rid={m.get('rid')} "
+            f"request={json.dumps(m.get('request'), default=str)}")
     return 0
 
 
@@ -1278,6 +1372,25 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--flight-dir", default=None,
                     help="incident dump directory (default "
                          "$PIO_FLIGHT_DIR or ~/.pio_tpu/flight)")
+    sp.add_argument("--capture-dir", default=None,
+                    help="enable golden-traffic capture: persist sampled "
+                         "request/response/provenance triples to this "
+                         "journal directory (replay with `pio replay`)")
+    sp.add_argument("--capture-sample", type=float, default=0.01,
+                    help="fraction of served queries captured "
+                         "(default 0.01; 1.0 captures everything)")
+    sp.add_argument("--capture-ring", type=int, default=256,
+                    help="in-memory capture ring size; the ring flushes "
+                         "to disk when full and on incidents")
+    sp.add_argument("--capture-max-mb", type=float, default=64.0,
+                    help="on-disk capture journal cap in MiB; the oldest "
+                         "captured segments are dropped past it")
+    sp.add_argument("--shadow-target", default=None,
+                    help="mirror sampled live traffic fire-and-forget to "
+                         "this engine-server base URL and diff answers "
+                         "online (pio_shadow_diff_total{tier})")
+    sp.add_argument("--shadow-sample", type=float, default=1.0,
+                    help="fraction of served queries shadow-mirrored")
 
     sp = sub.add_parser("batchpredict")
     _add_engine_args(sp)
@@ -1459,6 +1572,52 @@ def build_parser() -> argparse.ArgumentParser:
                    help="also write flight-before.json/flight-after.json "
                         "bracketing the window into this local directory")
 
+    sp = sub.add_parser("capture",
+                        help="golden-traffic capture control: toggle a "
+                             "live server's recording, export a capture "
+                             "journal as JSONL")
+    c_sub = sp.add_subparsers(dest="capture_command", required=True)
+    for verb, hint in (("start", "(re-)enable recording on a live "
+                                 "server deployed with --capture-dir"),
+                       ("stop", "stop recording and flush the ring so "
+                                "everything captured is on disk")):
+        x = c_sub.add_parser(verb, help=hint)
+        x.add_argument("--url", default="http://localhost:8000",
+                       help="engine server base URL "
+                            "(default http://localhost:8000)")
+    x = c_sub.add_parser("export",
+                         help="rewrite a local capture journal as JSONL")
+    x.add_argument("dir", help="capture journal directory")
+    x.add_argument("--output", required=True,
+                   help="JSONL output path (one capture record per line)")
+
+    sp = sub.add_parser("replay",
+                        help="re-issue captured golden traffic and diff "
+                             "answers at three tiers (bitwise / top-k "
+                             "set / score tolerance)")
+    _add_engine_args(sp)
+    sp.add_argument("capture_dir", help="capture journal directory "
+                                        "(from deploy --capture-dir)")
+    sp.add_argument("--target", default=None,
+                    help="live engine-server base URL to replay against; "
+                         "omitted = rehydrate an instance in-process")
+    sp.add_argument("--engine-instance-id",
+                    help="in-process replay target instance (default: "
+                         "latest COMPLETED training)")
+    sp.add_argument("--retrieval-mode", choices=["exact", "ann"],
+                    default=None,
+                    help="override the engine-params retrieval.mode for "
+                         "the in-process replay target")
+    sp.add_argument("--score-tol", type=float, default=1e-6,
+                    help="relative score tolerance for the score_tol "
+                         "tier (default 1e-6)")
+    sp.add_argument("--show-mismatches", type=int, default=10,
+                    help="print at most N mismatched requests "
+                         "(default 10)")
+    sp.add_argument("--json", action="store_true",
+                    help="full machine-readable report instead of the "
+                         "summary")
+
     sp = sub.add_parser("top",
                         help="live terminal view of a deployed engine "
                              "server: qps/p50/mode/SLO burn, the HBM "
@@ -1511,6 +1670,8 @@ COMMANDS = {
     "top": cmd_top,
     "admin": cmd_admin,
     "profile": cmd_profile,
+    "capture": cmd_capture,
+    "replay": cmd_replay,
     "import": cmd_import,
     "export": cmd_export,
     "template": cmd_template,

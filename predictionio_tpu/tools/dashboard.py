@@ -20,7 +20,7 @@ import logging
 
 from aiohttp import web
 
-from ..obs.http import handle_metrics
+from ..obs.http import handle_metrics, make_trace_middleware
 from ..storage import Storage
 
 log = logging.getLogger("predictionio_tpu.dashboard")
@@ -157,7 +157,10 @@ async def handle_results_json(request: web.Request) -> web.Response:
 
 def create_dashboard_app(
         engine_url: str = "http://localhost:8000") -> web.Application:
-    app = web.Application(middlewares=[cors_middleware])
+    # ISSUE 13: every app stamps X-PIO-Request-ID — the dashboard and
+    # admin APIs were the trace-middleware gap
+    app = web.Application(middlewares=[make_trace_middleware(),
+                                       cors_middleware])
     app[ENGINE_URL_KEY] = engine_url
     app.router.add_get("/", handle_index)
     app.router.add_get("/slo.json", handle_slo)

@@ -46,6 +46,7 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
+import hashlib
 import os
 import tempfile
 import threading
@@ -65,6 +66,7 @@ from ..obs.device import LEDGER
 from ..obs.flight import FLIGHT
 from ..obs.http import handle_metrics, make_trace_middleware
 from ..obs.metrics import METRICS
+from ..obs.replay import PROVENANCE_HEADER
 from ..obs.training import TRAINING
 from ..obs.slo import SloTracker, default_objectives
 from ..obs.trace import TRACE_HEADER, ensure_request_id, trace_event
@@ -170,6 +172,12 @@ class Deployed:
     retriever_axis: str = "model"
     prewarm_batch: int = 0  # pre-compile executables for this batch ceiling
     retrieval: dict | None = None
+    # ISSUE 13 provenance facts, stamped at rehydration time: the model
+    # blob's content hash (storage metadata checksum) and a digest over
+    # the executable-cache keys this bundle compiled — together they
+    # name WHAT is serving, independent of instance-id reuse
+    blob_sha: str | None = dataclasses.field(default=None, init=False)
+    exec_cache_key: str | None = dataclasses.field(default=None, init=False)
 
     def _resolved_mesh(self, model):
         """``retriever_mesh`` for one model: pass-through, or the
@@ -198,6 +206,12 @@ class Deployed:
         # the swap is the double-buffered /reload: the old bundle keeps
         # serving until this one is fully on-device.
         import jax
+
+        try:
+            blob = Storage.get_models().get(self.instance.id)
+            self.blob_sha = getattr(blob, "checksum", None)
+        except Exception:  # noqa: BLE001 — provenance is best-effort
+            self.blob_sha = None
 
         mode = str((self.retrieval or {}).get("mode", "exact")).lower()
         if (jax.default_backend() != "tpu" and self.retriever_mesh is None
@@ -247,6 +261,7 @@ class Deployed:
         and a full window (pad ``prewarm_batch``); both are pinned in the
         executable cache (ops/retrieval.py EXEC_CACHE)."""
         sizes = sorted({1, self.prewarm_batch})
+        warmed_keys: list = []
         for model in self.result.models:
             for attr in ("_retriever", "_sim_retriever"):
                 r = getattr(model, attr, None)
@@ -254,11 +269,20 @@ class Deployed:
                     continue
                 try:
                     warmed = r.prewarm(batch_sizes=sizes)
+                    warmed_keys.extend(warmed or ())
                     log.info("prewarmed %s.%s shapes %s",
                              type(model).__name__, attr, warmed)
                 except Exception:  # pragma: no cover - warming is advisory
                     log.exception("executable prewarm failed; first "
                                   "queries will compile on demand")
+        if warmed_keys:
+            # one digest naming the compiled-program configuration this
+            # bundle serves from (the warmed EXEC_CACHE keys carry
+            # namespace + shapes + dtype + quantization); None when the
+            # bundle serves host scoring (nothing compiled to name)
+            self.exec_cache_key = hashlib.sha256(
+                "\n".join(sorted(repr(k) for k in warmed_keys)).encode()
+            ).hexdigest()[:16]
 
 
 class EngineServer:
@@ -294,6 +318,12 @@ class EngineServer:
         slo_latency_ms: float = 0.0,
         flight_capacity: int = 256,
         flight_dump_dir: str | None = None,
+        capture_dir: str | None = None,
+        capture_sample: float = 1.0,
+        capture_ring: int = 256,
+        capture_max_mb: float = 64.0,
+        shadow_target: str | None = None,
+        shadow_sample: float = 1.0,
     ):
         self.engine = engine
         self.ctx = ctx or Context(mode="Serving")
@@ -421,6 +451,33 @@ class EngineServer:
                               dump_dir=flight_dump_dir)
         self.flight.set_context_provider(self._flight_context)
         self._profiling = False  # one live jax.profiler window at a time
+        # ISSUE 13: provenance envelope cache — assembled once per
+        # (bundle, patch epoch, mode) and stamped (as a compact-JSON
+        # header) on every response, so the hot path pays a tuple
+        # compare, not a retrieval-stats walk + json.dumps per request
+        self._prov_cache: tuple | None = None
+        # golden-traffic capture (obs/capture.py): per-server, active
+        # only when a capture directory is configured; /capture/start
+        # and /capture/stop toggle recording at runtime
+        self.capture = None
+        if capture_dir:
+            from ..obs.capture import CaptureRing
+
+            self.capture = CaptureRing(
+                capture_dir, sample=capture_sample,
+                ring_capacity=capture_ring,
+                max_bytes=int(capture_max_mb * 1024 * 1024))
+            # incident flush: the requests that led INTO an incident are
+            # exactly the golden traffic worth keeping on disk
+            self.flight.add_incident_listener(
+                lambda reason, path: self.capture.flush("incident"))
+        # shadow mirror (obs/replay.py): sampled live traffic re-issued
+        # fire-and-forget against a second instance with online diffs
+        self.shadow = None
+        if shadow_target:
+            from ..obs.replay import ShadowMirror
+
+            self.shadow = ShadowMirror(shadow_target, sample=shadow_sample)
 
     def _flight_context(self) -> dict:
         """Ambient context stamped into flight snapshots/dumps: what the
@@ -437,7 +494,55 @@ class EngineServer:
         }
         if self.admission is not None:
             ctx["admission"] = self.admission.pressure_snapshot()
+        # ISSUE 13: an incident file must name the exact model/config
+        # that was serving when it fired — same block /stats.json shows
+        try:
+            ctx["provenance"] = self.provenance()
+        except Exception:  # noqa: BLE001 — context must never block a dump
+            pass
         return ctx
+
+    # -- provenance envelope (ISSUE 13) ------------------------------------
+    def provenance(self, bundle: "Deployed | None" = None) -> dict:
+        """The identity of what is serving, as one block: engine
+        instance id, model blob sha256, delta patch epoch, retrieval
+        mode/nprobe/mesh, executable-cache key, and server mode. Cached
+        per (bundle, epoch, mode) — cheap enough to stamp per request."""
+        bundle = bundle if bundle is not None else self.deployed
+        cached = self._prov_cache
+        if cached is not None and cached[0] is bundle \
+                and cached[1] == self.patch_epoch and cached[2] == self._mode:
+            return cached[3]
+        r = self._retrieval_stats(bundle) or {}
+        mesh = bundle.retriever_mesh
+        if mesh is None or isinstance(mesh, str):
+            mesh_desc = mesh
+        else:
+            try:
+                mesh_desc = dict(getattr(mesh, "shape", {})) or str(mesh)
+            except Exception:  # noqa: BLE001
+                mesh_desc = str(mesh)
+        prov = {
+            "engineInstanceId": bundle.instance.id,
+            "modelBlobSha256": bundle.blob_sha,
+            "patchEpoch": self.patch_epoch,
+            "retrieval": {
+                "mode": r.get("mode", "host"),
+                "nprobe": r.get("nprobe"),
+                "mesh": mesh_desc,
+            },
+            "execCacheKey": bundle.exec_cache_key,
+            "mode": self._mode,
+        }
+        header = json.dumps(prov, separators=(",", ":"), default=str)
+        self._prov_cache = (bundle, self.patch_epoch, self._mode, prov,
+                            header)
+        return prov
+
+    def provenance_header(self) -> str:
+        """The same envelope as compact JSON for the response header."""
+        self.provenance()
+        return self._prov_cache[4]
 
     # -- resilience: unified mode (normal/brownout/degraded), deadlines ----
     @property
@@ -619,6 +724,10 @@ class EngineServer:
             await self.batcher.drain()
         if self.feedback is not None:
             await self.feedback.aclose()
+        if self.capture is not None:
+            self.capture.close()
+        if self.shadow is not None:
+            await self.shadow.aclose()
         self._drained = True
         log.info("drain complete (served %d request(s) lifetime)",
                  self.request_count)
@@ -1045,6 +1154,9 @@ class EngineServer:
                 "tableMax": self.patch_table_max,
                 "discardedByReload": self.patch_discarded,
             }
+            # ISSUE 13: the scattered identity fields above, unified in
+            # one block — the same envelope every response header carries
+            prov_block = self.provenance(bundle)
 
         def _hist(name: str):
             h = METRICS.get(name)
@@ -1088,6 +1200,9 @@ class EngineServer:
             "model": model_block,
             # ISSUE 10: streaming delta hot-patch posture
             "patches": patches_block,
+            "provenance": prov_block,
+            "capture": self.capture.stats() if self.capture else None,
+            "shadow": self.shadow.stats() if self.shadow else None,
             "feedback": self.feedback.stats() if self.feedback else None,
             # ISSUE 12: the device ledger (HBM by component, compile
             # times, padding waste) + train/stream convergence
@@ -1115,6 +1230,10 @@ async def handle_query(request: web.Request) -> web.Response:
     if server.instrumentation:
         wf = Waterfall(rid=rid)
         sink_token = set_stage_sink(wf)
+    # the EFFECTIVE query (post brownout clamp) — what capture persists
+    # and replay re-issues, so replay against a normal-mode server is
+    # still deterministic
+    eff_query: dict | None = None
 
     def _done(status_label: str, body: dict, status: int = 200,
               retry_after_s: float | None = None) -> web.Response:
@@ -1134,6 +1253,15 @@ async def handle_query(request: web.Request) -> web.Response:
         trace_event("serve.ingress", status=status_label,
                     http=status, ms=round((time.perf_counter() - t0) * 1e3, 3))
         headers = {TRACE_HEADER: rid}
+        # ISSUE 13: every response names exactly what served it
+        try:
+            headers[PROVENANCE_HEADER] = server.provenance_header()
+        except Exception:  # noqa: BLE001 — provenance must not 500 a query
+            pass
+        if server.capture is not None and eff_query is not None:
+            server.capture.record(
+                rid=rid, request=eff_query, response=body, status=status,
+                latency_ms=wall * 1e3, provenance=server.provenance())
         if retry_after_s is not None:
             # decimal seconds: our own clients (FeedbackPublisher) parse
             # floats, and sub-second pacing matters at serving rates
@@ -1168,9 +1296,9 @@ async def handle_query(request: web.Request) -> web.Response:
     # admission stage; the batcher (or fallback path) owns time from here
     mark_stage("admission")
     try:
+        eff_query = server.brownout_degrade(query_json)
         result = await server.dispatch_query(
-            server.brownout_degrade(query_json),
-            deadline=server.request_deadline(request))
+            eff_query, deadline=server.request_deadline(request))
     except DeadlineExceeded as e:
         return _done("deadline", {"message": str(e)}, 504)
     except DispatchTimeout as e:
@@ -1180,6 +1308,10 @@ async def handle_query(request: web.Request) -> web.Response:
     except Exception as e:  # noqa: BLE001 — surface as 400 like the reference
         log.exception("query failed")
         return _done("error", {"message": str(e)}, 400)
+    if server.shadow is not None and isinstance(result, dict):
+        # fire-and-forget mirror of the effective query to the shadow
+        # target; the diff tier lands on pio_shadow_diff_total
+        server.shadow.mirror(eff_query, result, rid)
     publish = server.feedback is not None
     if publish and server.mode != "normal":
         # brownout/degraded sheds feedback publication first — it is the
@@ -1335,6 +1467,33 @@ async def handle_profile(request: web.Request) -> web.Response:
     })
 
 
+async def handle_capture_start(request: web.Request) -> web.Response:
+    """POST /capture/start — (re-)enable golden-traffic recording. 409
+    when the server was deployed without --capture-dir: the ring and its
+    journal only exist when a directory was provisioned at deploy."""
+    server: EngineServer = request.app[SERVER_KEY]
+    if server.capture is None:
+        return web.json_response(
+            {"message": "capture is not configured; deploy with "
+                        "--capture-dir"}, status=409)
+    server.capture.start()
+    return web.json_response({"message": "Capture started.",
+                              "capture": server.capture.stats()})
+
+
+async def handle_capture_stop(request: web.Request) -> web.Response:
+    """POST /capture/stop — stop recording and flush the ring so
+    everything captured so far is on disk for export/replay."""
+    server: EngineServer = request.app[SERVER_KEY]
+    if server.capture is None:
+        return web.json_response(
+            {"message": "capture is not configured; deploy with "
+                        "--capture-dir"}, status=409)
+    server.capture.stop()
+    return web.json_response({"message": "Capture stopped and flushed.",
+                              "capture": server.capture.stats()})
+
+
 async def handle_stop(request: web.Request) -> web.Response:
     server: EngineServer = request.app[SERVER_KEY]
 
@@ -1367,6 +1526,8 @@ def create_engine_server_app(server: EngineServer) -> web.Application:
     app.router.add_post("/reload/delta", handle_reload_delta)
     app.router.add_get("/debug/flight.json", handle_flight)
     app.router.add_post("/debug/profile", handle_profile)
+    app.router.add_post("/capture/start", handle_capture_start)
+    app.router.add_post("/capture/stop", handle_capture_stop)
     app.router.add_get("/stop", handle_stop)
 
     async def _drain_server(app):

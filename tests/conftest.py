@@ -68,6 +68,12 @@ def pytest_configure(config):
         "with -m streaming")
     config.addinivalue_line(
         "markers",
+        "replay: capture/replay parity tests (the golden-traffic capture "
+        "ring, deterministic replay diffing and the provenance envelope "
+        "— obs/capture.py, obs/replay.py; test_capture_replay.py); "
+        "shares the chaos guard's SIGALRM timeout; select with -m replay")
+    config.addinivalue_line(
+        "markers",
         "retrieval: ANN / exact retrieval tests (the quantized IVF index, "
         "its exact-fallback and parity contracts, and the adaptive "
         "shard-count cost model — ops/ann.py, ops/retrieval.py; "
@@ -89,7 +95,8 @@ def _chaos_guard(request):
     poison unrelated tests."""
     if (request.node.get_closest_marker("chaos") is None
             and request.node.get_closest_marker("train_chaos") is None
-            and request.node.get_closest_marker("streaming") is None):
+            and request.node.get_closest_marker("streaming") is None
+            and request.node.get_closest_marker("replay") is None):
         yield
         return
 
