@@ -1574,6 +1574,185 @@ print("CAPOVH persisted %d" % persisted, flush=True)
             "capture_persisted_records": persisted}
 
 
+def multi_variant_bench() -> dict:
+    """ISSUE 14 gate: co-hosting a second variant in the same process
+    must be near-free. Two EngineServers over the SAME trained bundle
+    split 50/50 by the hashed router must serve >= 0.9x the qps of a
+    single-variant server (paired rounds, median-of-rounds), and the
+    shared-compile story must hold: a second same-shaped retriever's
+    prewarm is pure ExecutableCache hits (size and misses unchanged,
+    zero new compile seconds) and the HBM executable ledger does NOT
+    double."""
+    code = r"""
+import asyncio, json, os, sys, threading, time
+sys.path.insert(0, os.environ["REPO"])
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+
+from aiohttp import web
+from predictionio_tpu.controller import Engine, EngineParams
+from predictionio_tpu.storage import Storage
+from predictionio_tpu.testing.sample_engine import (
+    SampleAlgoParams, SampleAlgorithm, SampleDataSource,
+    SampleDataSourceParams, SamplePreparator, SampleQuery, SampleServing)
+from predictionio_tpu.workflow import Context, run_train
+from predictionio_tpu.workflow.create_server import (
+    EngineServer, create_engine_server_app)
+
+class EchoAlgorithm(SampleAlgorithm):
+    query_class = SampleQuery
+
+def make_engine():
+    return Engine(data_source_classes=SampleDataSource,
+                  preparator_classes=SamplePreparator,
+                  algorithm_classes={"echo": EchoAlgorithm},
+                  serving_classes=SampleServing)
+
+Storage.reset()
+for repo in ("METADATA", "EVENTDATA", "MODELDATA"):
+    Storage.configure(repo, "memory")
+engine = make_engine()
+ep = EngineParams(
+    data_source_params=("", SampleDataSourceParams(id=0)),
+    algorithm_params_list=(("echo", SampleAlgoParams(id=1)),))
+iid = run_train(engine, ep, Context(), engine_factory="__main__:make_engine")
+instance = Storage.get_metadata().engine_instance_get(iid)
+
+def start(server):
+    loop = asyncio.new_event_loop()
+    ready, holder = threading.Event(), {}
+    async def _start():
+        runner = web.AppRunner(create_engine_server_app(server))
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        holder["port"] = runner.addresses[0][1]
+        ready.set()
+    def _run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(_start())
+        loop.run_forever()
+    threading.Thread(target=_run, daemon=True).start()
+    assert ready.wait(30), "engine server failed to start"
+    return holder["port"]
+
+# one bundle, three servers: a single-variant baseline app and a
+# two-variant app whose primary hash-routes 50/50 to itself + a child
+single = EngineServer(engine, instance, instrumentation=True)
+primary = EngineServer(engine, instance, instrumentation=True)
+child = EngineServer(engine, instance, instrumentation=True,
+                     variant_id="b")
+primary.flight.set_context_provider(primary._flight_context)
+primary.variants.register("b", child, weight=1.0)
+ports = {"single": start(single), "multi": start(primary)}
+
+import http.client
+conns = {label: http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+         for label, port in ports.items()}
+seq = {"single": 0, "multi": 0}
+def block(label, n):
+    conn = conns[label]
+    t0 = time.perf_counter()
+    for _ in range(n):
+        seq[label] += 1
+        body = json.dumps({"q": seq[label]}).encode()
+        conn.request("POST", "/queries.json", body=body,
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        assert r.status == 200
+        r.read()
+    return n / (time.perf_counter() - t0)
+
+for label in ("single", "multi"):  # warm: compile, caches, TCP stacks
+    block(label, 100)
+qps = {"single": [], "multi": []}
+for _ in range(5):                 # paired rounds: drift hits both
+    for label in ("single", "multi"):
+        qps[label].append(block(label, 200))
+def med(xs):
+    s = sorted(xs)
+    return s[len(s) // 2]
+print("MVAR qps_single %.2f" % med(qps["single"]), flush=True)
+print("MVAR qps_multi %.2f" % med(qps["multi"]), flush=True)
+# both variants really took hashed traffic
+from predictionio_tpu.workflow import variants as V
+routed = {e.variant_id: int(V._M_ROUTED.value(e.variant_id, "hashed"))
+          for e in primary.variants.entries()}
+print("MVAR routed_default %d" % routed["default"], flush=True)
+print("MVAR routed_b %d" % routed["b"], flush=True)
+
+# shared-compile evidence: a second same-shaped retriever prewarms
+# entirely from the process ExecutableCache — no new compiles, no new
+# executable residency in the HBM ledger
+from predictionio_tpu.obs.device import LEDGER
+from predictionio_tpu.ops.retrieval import EXEC_CACHE, DeviceRetriever
+rng = np.random.default_rng(0)
+items_a = rng.standard_normal((512, 16)).astype(np.float32)
+items_b = rng.standard_normal((512, 16)).astype(np.float32)
+t0 = time.perf_counter()
+DeviceRetriever(items_a, tile_n=128).prewarm(batch_sizes=(8,), ks=(10,))
+t_first = time.perf_counter() - t0
+s1 = EXEC_CACHE.stats()
+hbm1 = LEDGER.snapshot()["totalBytes"]
+t0 = time.perf_counter()
+DeviceRetriever(items_b, tile_n=128).prewarm(batch_sizes=(8,), ks=(10,))
+t_second = time.perf_counter() - t0
+s2 = EXEC_CACHE.stats()
+hbm2 = LEDGER.snapshot()["totalBytes"]
+print("MVAR compile_first_s %.4f" % t_first, flush=True)
+print("MVAR compile_second_s %.4f" % t_second, flush=True)
+print("MVAR cache_size %d %d" % (s1["size"], s2["size"]), flush=True)
+print("MVAR cache_misses %d %d" % (s1["misses"], s2["misses"]), flush=True)
+print("MVAR cache_hits %d %d" % (s1["hits"], s2["hits"]), flush=True)
+print("MVAR hbm_bytes %d %d" % (hbm1, hbm2), flush=True)
+"""
+    rows = {r[0]: r[1:] for r in _run_tagged_child(code, "MVAR", 600)}
+    qps_single = float(rows["qps_single"][0])
+    qps_multi = float(rows["qps_multi"][0])
+    routed = (int(rows["routed_default"][0]), int(rows["routed_b"][0]))
+    size1, size2 = (int(x) for x in rows["cache_size"])
+    miss1, miss2 = (int(x) for x in rows["cache_misses"])
+    hits1, hits2 = (int(x) for x in rows["cache_hits"])
+    hbm1, hbm2 = (int(x) for x in rows["hbm_bytes"])
+    ratio = qps_multi / qps_single
+    if ratio < 0.9:
+        raise RuntimeError(
+            f"multi-variant qps gate: two co-hosted variants serve "
+            f"{qps_multi:.0f} qps vs {qps_single:.0f} single-variant "
+            f"({ratio:.2f}x < 0.9x) — routing must stay one hash draw")
+    if min(routed) == 0:
+        raise RuntimeError(
+            f"multi-variant split gate: hashed routing sent {routed} "
+            f"requests to (default, b) — one variant starved at 50/50")
+    if size2 != size1 or miss2 != miss1:
+        raise RuntimeError(
+            f"shared-compile gate: second same-shape prewarm grew the "
+            f"ExecutableCache (size {size1}->{size2}, misses "
+            f"{miss1}->{miss2}) — variants must share executables")
+    if hits2 <= hits1:
+        raise RuntimeError(
+            "shared-compile gate: second prewarm produced no cache hits")
+    if hbm2 >= 2 * hbm1 and hbm1 > 0:
+        raise RuntimeError(
+            f"HBM ledger gate: executable residency doubled "
+            f"({hbm1} -> {hbm2} bytes) despite identical shapes")
+    log(f"multi-variant serving: {qps_multi:.0f} qps with 2 variants vs "
+        f"{qps_single:.0f} single ({ratio:.2f}x); hashed split "
+        f"{routed[0]}/{routed[1]}; second prewarm {hits2 - hits1} cache "
+        f"hits, 0 new compiles, ledger {hbm1} -> {hbm2} bytes")
+    return {"multi_variant_qps_single": round(qps_single, 1),
+            "multi_variant_qps_two": round(qps_multi, 1),
+            "multi_variant_qps_ratio": round(ratio, 3),
+            "multi_variant_hashed_split": list(routed),
+            "multi_variant_prewarm_first_s": float(rows["compile_first_s"][0]),
+            "multi_variant_prewarm_second_s": float(
+                rows["compile_second_s"][0]),
+            "multi_variant_exec_cache_hits_second": hits2 - hits1,
+            "multi_variant_hbm_bytes": [hbm1, hbm2]}
+
+
 def _cache_dir() -> str:
     d = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".xla_cache")
     os.makedirs(d, exist_ok=True)
@@ -1942,6 +2121,7 @@ def main() -> None:
         ("streaming fold-in", streaming_foldin_bench, 900, False),
         ("observability overhead", observability_overhead_bench, 600, False),
         ("capture overhead", capture_overhead_bench, 600, False),
+        ("multi-variant serving", multi_variant_bench, 600, False),
     ]
     if platform != "tpu":
         # the e2e child pins itself to the host backend (PIO_PLATFORM),

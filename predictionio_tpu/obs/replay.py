@@ -48,11 +48,17 @@ from .trace import TRACE_HEADER
 log = logging.getLogger("predictionio_tpu.replay")
 
 __all__ = ["diff_tier", "replay_records", "ShadowMirror",
-           "PROVENANCE_HEADER", "TIERS"]
+           "PROVENANCE_HEADER", "VARIANT_HEADER", "TIERS"]
 
 #: compact-JSON provenance envelope stamped on every serving response
 #: (workflow/create_server.py) — replay reads it back from live targets
 PROVENANCE_HEADER = "X-PIO-Provenance"
+
+#: forced-routing override (ISSUE 14, workflow/variants.py): replay
+#: stamps each record's captured variant id here so the replayed query
+#: re-hits the variant that originally answered it, not the hash bucket
+#: the target's CURRENT weights would pick
+VARIANT_HEADER = "X-PIO-Variant"
 
 TIERS = ("bitwise", "topk_set", "score_tol", "mismatch", "error")
 
@@ -137,11 +143,18 @@ def _http_issue(target: str, timeout_s: float):
     base = target.rstrip("/")
 
     def issue(record: dict):
+        headers = {"Content-Type": "application/json",
+                   TRACE_HEADER: f"replay-{record.get('rid', '')}"}
+        # ISSUE 14: pin the replay to the variant that answered the
+        # captured request — a multi-variant target must not re-hash
+        # the query into whatever its current weights say
+        vid = (record.get("provenance") or {}).get("variantId")
+        if vid:
+            headers[VARIANT_HEADER] = str(vid)
         req = urllib.request.Request(
             f"{base}/queries.json",
             data=json.dumps(record["request"]).encode(),
-            headers={"Content-Type": "application/json",
-                     TRACE_HEADER: f"replay-{record.get('rid', '')}"},
+            headers=headers,
             method="POST")
         with urllib.request.urlopen(req, timeout=timeout_s) as resp:
             body = json.loads(resp.read().decode())
@@ -183,6 +196,18 @@ def replay_records(records, *, target: str | None = None, server=None,
         raise ValueError("replay needs exactly one of target= or server=")
     issue = _http_issue(target, timeout_s) if target else _server_issue(server)
     tiers = {t: 0 for t in TIERS}
+    # ISSUE 14: parity grouped by the variant that answered at capture
+    # time — the A/B diff reads straight off one capture
+    by_variant: dict[str, dict] = {}
+
+    def _vtier(rec: dict, tier: str) -> None:
+        vid = str((rec.get("provenance") or {}).get("variantId")
+                  or "default")
+        vt = by_variant.setdefault(
+            vid, {"total": 0, "tiers": {t: 0 for t in TIERS}})
+        vt["total"] += 1
+        vt["tiers"][tier] += 1
+
     mismatches: list[dict] = []
     captured_ms: list[float] = []
     replayed_ms: list[float] = []
@@ -202,6 +227,7 @@ def replay_records(records, *, target: str | None = None, server=None,
             body, prov, _ok = issue(rec)
         except Exception as e:  # noqa: BLE001 — report, don't die mid-run
             tiers["error"] += 1
+            _vtier(rec, "error")
             if len(mismatches) < mismatch_cap:
                 mismatches.append({"rid": rec.get("rid"),
                                    "tier": "error",
@@ -218,6 +244,7 @@ def replay_records(records, *, target: str | None = None, server=None,
         tier = diff_tier(_strip_volatile(rec.get("response")),
                          _strip_volatile(body), score_tol)
         tiers[tier] += 1
+        _vtier(rec, tier)
         if tier != "bitwise" and len(mismatches) < mismatch_cap:
             mismatches.append({
                 "rid": rec.get("rid"),
@@ -240,6 +267,13 @@ def replay_records(records, *, target: str | None = None, server=None,
             "captured": capture_prov,
             "replayed": replay_prov,
             "delta": _provenance_delta(capture_prov, replay_prov),
+        },
+        "variants": {
+            vid: {**vt,
+                  "parityPct": (round(
+                      100.0 * vt["tiers"]["bitwise"] / vt["total"], 3)
+                      if vt["total"] else None)}
+            for vid, vt in sorted(by_variant.items())
         },
         "mismatches": mismatches,
     }

@@ -129,7 +129,10 @@ def _verify_template_min_version(engine_dir: Path) -> None:
 def _engine_ids(engine_dir: Path, variant: dict) -> tuple[str, str, str]:
     engine_id = variant.get("id") or engine_dir.resolve().name
     version = str(variant.get("version", "1"))
-    variant_id = variant.get("id", "default")
+    # ISSUE 14: the variant id is its OWN field — it used to read
+    # variant.get("id"), which made the variant id track the engine id
+    # and two variants of one engine indistinguishable in metadata
+    variant_id = str(variant.get("variantId", "default"))
     return engine_id, version, variant_id
 
 
@@ -464,7 +467,70 @@ def _retrieval_params(engine_dir: Path, args) -> dict | None:
     return block or None
 
 
+def _deploy_variant(args) -> int:
+    """``pio deploy --variant-of <port>`` (ISSUE 14): instead of binding
+    a new server, register this engine as another serving variant of the
+    engine server already running on that port. The bundle must live in
+    THAT process, so the CLI only posts the recipe (engine dir + variant
+    json + optional pinned instance) and the server deploys it."""
+    import urllib.error
+    import urllib.request
+
+    engine_dir = Path(args.engine_dir)
+    _verify_template_min_version(engine_dir)
+    variant = _load_variant(engine_dir, args.engine_json)
+    vid = args.variant_id or str(
+        variant.get("variantId") or engine_dir.resolve().name)
+    body = {
+        "variantId": vid,
+        "weight": args.weight,
+        "engineDir": str(engine_dir.resolve()),
+        "engineJson": args.engine_json,
+        "batchWindowMs": args.batch_window_ms,
+        "batchMax": args.batch_max,
+        "batchInflight": args.batch_inflight,
+        "deadlineMs": args.deadline_ms,
+        "admission": args.admission,
+        "admissionQueueHigh": args.admission_queue_high,
+        "admissionWaitBudgetMs": args.admission_wait_budget_ms,
+        "rateLimitQps": args.rate_limit_qps,
+        "rateLimitBurst": args.rate_limit_burst,
+        "brownoutTopk": args.brownout_topk,
+        "sloLatencyMs": args.slo_latency_ms,
+    }
+    if args.engine_instance_id:
+        body["engineInstanceId"] = args.engine_instance_id
+    retrieval = _retrieval_params(engine_dir, args)
+    if retrieval:
+        body["retrieval"] = retrieval
+    url = f"http://{args.ip if args.ip != '0.0.0.0' else '127.0.0.1'}" \
+          f":{args.variant_of}/variants"
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(), method="POST",
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=300) as r:
+            out = json.loads(r.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            msg = json.loads(e.read().decode()).get("message", str(e))
+        except Exception:  # noqa: BLE001
+            msg = str(e)
+        _die(f"variant registration failed ({e.code}): {msg}")
+    except OSError as e:
+        _die(f"no engine server answering at {url}: {e}")
+    _ok(f"Registered variant {out.get('variantId')!r} "
+        f"(instance {out.get('engineInstanceId')}, "
+        f"state {out.get('state')}, weight {out.get('weight')}) "
+        f"on port {args.variant_of}")
+    _ok(f"  promote with: pio variant promote {out.get('variantId')} "
+        f"--url http://127.0.0.1:{args.variant_of}")
+    return 0
+
+
 def cmd_deploy(args) -> int:
+    if args.variant_of:
+        return _deploy_variant(args)
     _enable_compile_cache()
     from ..workflow.create_server import run_engine_server
 
@@ -690,6 +756,7 @@ def cmd_stream(args) -> int:
         solver=args.fold_in_solver,
         breaker_threshold=args.breaker_threshold,
         breaker_reset_s=args.breaker_reset_s,
+        variant=args.variant,
     )
     _ok(f"Streaming updater: journal {args.journal_dir} -> "
         f"{args.engine_url} (model instance {inst.id}, gate "
@@ -874,6 +941,66 @@ def cmd_capture(args) -> int:
     return 0
 
 
+def cmd_variant(args) -> int:
+    """``pio variant list|weight|promote|retire`` (ISSUE 14) — manage
+    the variant table of a running engine server: inspect the traffic
+    split, re-weight the hash buckets, flip a candidate live, or take a
+    variant out of rotation."""
+    import urllib.error
+    import urllib.request
+
+    base = args.url.rstrip("/")
+
+    def _call(path: str, method: str = "POST", payload: dict | None = None):
+        req = urllib.request.Request(
+            f"{base}{path}",
+            data=(json.dumps(payload).encode()
+                  if payload is not None else None),
+            method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.loads(r.read().decode())
+        except urllib.error.HTTPError as e:
+            try:
+                msg = json.loads(e.read().decode()).get("message", str(e))
+            except Exception:  # noqa: BLE001
+                msg = str(e)
+            _die(f"variant {args.variant_command} failed ({e.code}): {msg}")
+        except OSError as e:
+            _die(f"no engine server answering at {base}: {e}")
+
+    if args.variant_command == "list":
+        snap = _call("/variants.json", method="GET")
+        _ok(f"{snap['count']} variant(s):")
+        for v in snap["variants"]:
+            share = v.get("trafficShare", 0.0)
+            routed = v.get("routed", {})
+            _ok(f"  {v['variantId']:<16} state={v['state']:<9} "
+                f"weight={v['weight']:<6g} share={share:.1%} "
+                f"instance={v.get('engineInstanceId')} "
+                f"routed(hashed={routed.get('hashed', 0)} "
+                f"forced={routed.get('forced', 0)} "
+                f"default={routed.get('default', 0)})")
+        return 0
+    if args.variant_command == "weight":
+        out = _call(f"/variants/{args.variant_id}/weight",
+                    payload={"weight": args.weight})
+        _ok(f"Variant {out.get('variantId')!r} weight -> "
+            f"{out.get('weight')} (share {out.get('trafficShare', 0):.1%})")
+        return 0
+    if args.variant_command == "promote":
+        out = _call(f"/variants/{args.variant_id}/promote")
+        _ok(f"Promoted {out.get('promoted')!r} to live "
+            f"(previous live: {out.get('previousLive')!r})")
+        return 0
+    # retire
+    out = _call(f"/variants/{args.variant_id}/retire")
+    _ok(f"Retired {out.get('variantId')!r} (weight 0; still reachable "
+        f"via the X-PIO-Variant header for replay)")
+    return 0
+
+
 def cmd_replay(args) -> int:
     """``pio replay <capture-dir>`` re-issues captured golden traffic
     and prints the three-tier parity report (obs/replay.py). Target is
@@ -912,6 +1039,17 @@ def cmd_replay(args) -> int:
     _ok(f"  tiers: bitwise={t['bitwise']} topk_set={t['topk_set']} "
         f"score_tol={t['score_tol']} mismatch={t['mismatch']} "
         f"error={t['error']}")
+    # ISSUE 14: the A/B read — parity per captured variant, so a capture
+    # spanning an experiment diffs each arm against itself
+    by_variant = report.get("variants") or {}
+    if len(by_variant) > 1:
+        _ok("  by variant:")
+        for vid in sorted(by_variant):
+            vt = by_variant[vid]
+            vtiers = vt["tiers"]
+            _ok(f"    {vid}: n={vt['total']} parity={vt['parityPct']}% "
+                f"(bitwise={vtiers['bitwise']} "
+                f"mismatch={vtiers['mismatch']} error={vtiers['error']})")
     lat = report["latencyMs"]
     _ok(f"  p50 latency ms: captured={lat['captured']} "
         f"replayed={lat['replayed']}")
@@ -1305,6 +1443,19 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--ip", default="0.0.0.0")
     sp.add_argument("--port", type=int, default=8000)
     sp.add_argument("--engine-instance-id")
+    sp.add_argument("--variant-of", type=int, default=None, metavar="PORT",
+                    help="register this engine as another serving variant "
+                         "of the engine server already running on PORT "
+                         "(same process, same device pool) instead of "
+                         "binding a new server; the new variant starts as "
+                         "a candidate with --weight traffic")
+    sp.add_argument("--weight", type=float, default=0.0,
+                    help="initial traffic weight for --variant-of "
+                         "(hashed A/B share relative to the other "
+                         "variants' weights; 0 = forced-header only)")
+    sp.add_argument("--variant-id", default=None,
+                    help="variant name for --variant-of (default: the "
+                         "engine.json variantId, else the engine dir name)")
     sp.add_argument("--feedback", action="store_true")
     sp.add_argument("--event-server-url", default="http://localhost:7070")
     sp.add_argument("--accesskey")
@@ -1507,6 +1658,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--breaker-reset-s", type=float, default=5.0,
                     help="seconds between half-open probes while the "
                          "publish breaker is open (default 5)")
+    sp.add_argument("--variant", default=None,
+                    help="target serving variant for /reload/delta "
+                         "patches on a multi-variant server (unknown or "
+                         "retired variants are rejected 400; default: "
+                         "the live variant)")
 
     sp = sub.add_parser("adminserver")
     sp.add_argument("--ip", default="127.0.0.1")
@@ -1591,6 +1747,45 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--output", required=True,
                    help="JSONL output path (one capture record per line)")
 
+    sp = sub.add_parser("variant",
+                        help="manage a live engine server's variant "
+                             "table: list the traffic split, re-weight "
+                             "the hashed A/B buckets, promote a "
+                             "candidate live, retire a variant")
+    v_sub = sp.add_subparsers(dest="variant_command", required=True)
+    x = v_sub.add_parser("list", help="show every registered variant: "
+                                      "state, weight, traffic share, "
+                                      "routed-query counts")
+    x.add_argument("--url", default="http://localhost:8000",
+                   help="engine server base URL "
+                        "(default http://localhost:8000)")
+    x = v_sub.add_parser("weight",
+                         help="set a variant's traffic weight (hashed "
+                              "share is weight / sum of weights; only "
+                              "the affected hash buckets re-shuffle)")
+    x.add_argument("variant_id")
+    x.add_argument("weight", type=float)
+    x.add_argument("--url", default="http://localhost:8000",
+                   help="engine server base URL "
+                        "(default http://localhost:8000)")
+    x = v_sub.add_parser("promote",
+                         help="flip a candidate live, swapping traffic "
+                              "weights with the current live variant — "
+                              "in-flight requests are never dropped")
+    x.add_argument("variant_id")
+    x.add_argument("--url", default="http://localhost:8000",
+                   help="engine server base URL "
+                        "(default http://localhost:8000)")
+    x = v_sub.add_parser("retire",
+                         help="take a variant out of hashed rotation "
+                              "(still reachable via X-PIO-Variant for "
+                              "replay); live variants need a promoted "
+                              "replacement first")
+    x.add_argument("variant_id")
+    x.add_argument("--url", default="http://localhost:8000",
+                   help="engine server base URL "
+                        "(default http://localhost:8000)")
+
     sp = sub.add_parser("replay",
                         help="re-issue captured golden traffic and diff "
                              "answers at three tiers (bitwise / top-k "
@@ -1671,6 +1866,7 @@ COMMANDS = {
     "admin": cmd_admin,
     "profile": cmd_profile,
     "capture": cmd_capture,
+    "variant": cmd_variant,
     "replay": cmd_replay,
     "import": cmd_import,
     "export": cmd_export,

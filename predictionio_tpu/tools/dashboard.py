@@ -79,6 +79,36 @@ async def handle_train(request: web.Request) -> web.Response:
     })
 
 
+async def handle_variants(request: web.Request) -> web.Response:
+    """ISSUE 14: proxy the engine server's variant table — traffic
+    split, lifecycle states, per-variant request counters and hit@k-
+    style outcome series — so the A/B view reads off one dashboard
+    endpoint. Same 502 contract as /slo.json."""
+    import aiohttp
+
+    base = request.query.get("url") or request.app[ENGINE_URL_KEY]
+    try:
+        timeout = aiohttp.ClientTimeout(total=5)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            async with session.get(base.rstrip("/") + "/stats.json") as r:
+                stats = await r.json()
+    except Exception as e:  # noqa: BLE001 — report, don't crash the page
+        return web.json_response(
+            {"engineUrl": base, "error": f"engine server unreachable: {e}"},
+            status=502)
+    variants = stats.get("variants") or {}
+    return web.json_response({
+        "engineUrl": base,
+        "count": variants.get("count"),
+        # traffic split: per variant — state, weight, normalized share,
+        # routed counts by mechanism (hashed/forced/default)
+        "split": variants.get("variants"),
+        # per-variant serving slices: requests, SLO burn, admission,
+        # patch epoch, provenance — everything an A/B readout needs
+        "byVariant": variants.get("byVariant"),
+    })
+
+
 @web.middleware
 async def cors_middleware(request: web.Request, handler):
     """(reference CorsSupport.scala — allow-all CORS for dashboard XHR)"""
@@ -118,7 +148,9 @@ async def handle_index(request: web.Request) -> web.Response:
         f"{rows}</table>"
         '<p>Serving SLO burn rates and stage waterfalls: '
         '<a href="/slo.json">/slo.json</a>; train/stream convergence and '
-        'the device HBM ledger: <a href="/train.json">/train.json</a> '
+        'the device HBM ledger: <a href="/train.json">/train.json</a>; '
+        'A/B traffic split and per-variant serving: '
+        '<a href="/variants.json">/variants.json</a> '
         "(proxied from the engine server's /stats.json)</p></body></html>"
     )
     return web.Response(text=body, content_type="text/html")
@@ -165,6 +197,7 @@ def create_dashboard_app(
     app.router.add_get("/", handle_index)
     app.router.add_get("/slo.json", handle_slo)
     app.router.add_get("/train.json", handle_train)
+    app.router.add_get("/variants.json", handle_variants)
     app.router.add_get(
         "/engine_instances/{instance_id}/evaluator_results.txt", handle_results_txt
     )

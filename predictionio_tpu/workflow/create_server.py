@@ -79,6 +79,7 @@ from .feedback import FeedbackPublisher
 from .microbatch import DeadlineExceeded, DispatchTimeout, ServerBusy
 from .context import Context
 from .core_workflow import prepare_deploy
+from .variants import VARIANT_HEADER, VariantTable, entity_key
 
 log = logging.getLogger("predictionio_tpu.server")
 
@@ -288,6 +289,15 @@ class Deployed:
 class EngineServer:
     """Holds the deployed bundle + bookkeeping; handlers delegate here."""
 
+    #: class-level default so partially-constructed skeletons (tests
+    #: build them with object.__new__) still carry a variant identity
+    variant_id: str = "default"
+
+    #: latest eval-gate block a streaming updater rode along with its
+    #: delta publish (ISSUE 14: per-variant online hit@k for the A/B
+    #: dashboard view); None until a gated publish arrives
+    last_stream_gate: dict | None = None
+
     def __init__(
         self,
         engine: Engine,
@@ -324,11 +334,17 @@ class EngineServer:
         capture_max_mb: float = 64.0,
         shadow_target: str | None = None,
         shadow_sample: float = 1.0,
+        variant_id: str = "default",
     ):
         self.engine = engine
         self.ctx = ctx or Context(mode="Serving")
         self.engine_dir = engine_dir  # for re-resolving blob classes
         self.batch_max = batch_max
+        # ISSUE 14: the variant identity of THIS bundle. Every server is
+        # a variant (the single-engine case is a one-entry table); the
+        # PRIMARY server's table is the process-wide router that the
+        # /variants endpoints mutate.
+        self.variant_id = str(variant_id) or "default"
         #: instances skipped by the most recent deploy/reload because
         #: their blob was corrupt or unloadable — surfaced in
         #: /health.json and /stats.json so operators see the quarantine
@@ -418,7 +434,10 @@ class EngineServer:
                 admission_wait_budget_ms / 1e3 if admission_wait_budget_ms > 0
                 else (self.deadline_ms / 2e3 if self.deadline_ms > 0 else 0.0))
             self.admission = AdmissionController(
-                "serve",
+                # per-variant pressure plane: a candidate sheds alone
+                # without polluting the live variant's gauge series
+                ("serve" if self.variant_id == "default"
+                 else f"serve/{self.variant_id}"),
                 queue_depth=(lambda: len(b._pending)) if b else None,
                 queue_high=admission_queue_high,
                 wait_hist_name="pio_microbatch_queue_wait_seconds",
@@ -441,7 +460,14 @@ class EngineServer:
         slo_latency_s = (
             slo_latency_ms / 1e3 if slo_latency_ms > 0
             else (self.deadline_ms / 1e3 if self.deadline_ms > 0 else 0.25))
-        self.slo = SloTracker(default_objectives(deadline_s=slo_latency_s))
+        objectives = default_objectives(deadline_s=slo_latency_s)
+        if self.variant_id != "default":
+            # the SLO gauges (pio_slo_burn_rate{slo,window}) are shared
+            # label series — co-hosted variants need distinct slo names
+            # or two trackers would fight over one series
+            objectives = [dataclasses.replace(o, name=f"{o.name}@{self.variant_id}")
+                          for o in objectives]
+        self.slo = SloTracker(objectives)
         # flight recorder: the process singleton, configured per server
         # (ONE engine per process today; the singleton matches METRICS/
         # FAULTS idiom and lets the micro-batcher push hung waterfalls
@@ -478,6 +504,15 @@ class EngineServer:
             from ..obs.replay import ShadowMirror
 
             self.shadow = ShadowMirror(shadow_target, sample=shadow_sample)
+        # ISSUE 14: every server starts as the sole live variant of its
+        # own table; registering more variants turns the table into the
+        # hashed A/B router. Child servers' own tables sit unused — only
+        # the table on the server bound to the aiohttp app routes.
+        self.variants = VariantTable(self.variant_id, self)
+
+    @property
+    def engine_instance_id(self) -> str:
+        return self.deployed.instance.id
 
     def _flight_context(self) -> dict:
         """Ambient context stamped into flight snapshots/dumps: what the
@@ -524,6 +559,9 @@ class EngineServer:
                 mesh_desc = str(mesh)
         prov = {
             "engineInstanceId": bundle.instance.id,
+            # ISSUE 14: which variant answered — capture persists this,
+            # replay routes by it, and the parity report groups on it
+            "variantId": self.variant_id,
             "modelBlobSha256": bundle.blob_sha,
             "patchEpoch": self.patch_epoch,
             "retrieval": {
@@ -753,6 +791,7 @@ class EngineServer:
             "mode": self._mode,
             "live": True,
             "ready": not self._draining,
+            "variant": self.variant_id,
             "engineInstanceId": inst.id,
             "startTime": self.start_time.isoformat(),
             "admission": (self.admission.stats()
@@ -1086,7 +1125,8 @@ class EngineServer:
         table's factor rows are the one serving-side buffer that grows
         with traffic rather than with deployed shapes (ISSUE 12)."""
         LEDGER.track_buffer(
-            "patch_table",
+            ("patch_table" if self.variant_id == "default"
+             else f"patch_table/{self.variant_id}"),
             sum(int(v.nbytes) for v in self.patch_table.values()))
 
     def status(self) -> dict:
@@ -1123,6 +1163,40 @@ class EngineServer:
                     "sharded": type(r).__name__ == "ShardedDeviceRetriever"}
         return None
 
+    def variant_stats(self) -> dict:
+        """The per-variant slice of serving_stats (ISSUE 14): what is
+        distinct about THIS variant — counters, mode, SLO, admission,
+        patch posture, provenance. Shared-process blocks (execCache,
+        device ledger, waterfall histograms) stay on the top level of
+        /stats.json: they are shared by construction."""
+        with self._stats_lock:
+            counters = {
+                "requestCount": self.request_count,
+                "avgServingSec": self.avg_serving_sec,
+                "lastServingSec": self.last_serving_sec,
+            }
+        with self._reload_lock:
+            bundle = self.deployed
+            patches_block = {
+                "epoch": self.patch_epoch,
+                "patchedUsers": len(self.patch_table),
+                "tableMax": self.patch_table_max,
+                "discardedByReload": self.patch_discarded,
+            }
+            prov_block = self.provenance(bundle)
+        return {
+            "variant": self.variant_id,
+            **counters,
+            "mode": self._mode,
+            "slo": self.slo.summary(),
+            "admission": (self.admission.stats()
+                          if self.admission is not None else None),
+            "batching": self.batcher.stats() if self.batcher else None,
+            "patches": patches_block,
+            "streamGate": self.last_stream_gate,
+            "provenance": prov_block,
+        }
+
     def serving_stats(self) -> dict:
         """Machine-readable serving telemetry (GET /stats.json): request
         counters, micro-batcher window/occupancy, and the shared
@@ -1157,6 +1231,14 @@ class EngineServer:
             # ISSUE 13: the scattered identity fields above, unified in
             # one block — the same envelope every response header carries
             prov_block = self.provenance(bundle)
+        # ISSUE 14: traffic split + per-variant slices. On a child
+        # server this is its own one-entry table; on the primary it is
+        # the process router the /variants endpoints mutate.
+        variants_block = self.variants.snapshot()
+        if variants_block["count"] > 1:
+            variants_block["byVariant"] = {
+                e.variant_id: e.server.variant_stats()
+                for e in self.variants.entries()}
 
         def _hist(name: str):
             h = METRICS.get(name)
@@ -1201,6 +1283,9 @@ class EngineServer:
             # ISSUE 10: streaming delta hot-patch posture
             "patches": patches_block,
             "provenance": prov_block,
+            # ISSUE 14: variant table — traffic split and per-variant
+            # request/SLO/admission/patch slices
+            "variants": variants_block,
             "capture": self.capture.stats() if self.capture else None,
             "shadow": self.shadow.stats() if self.shadow else None,
             "feedback": self.feedback.stats() if self.feedback else None,
@@ -1215,7 +1300,11 @@ SERVER_KEY = web.AppKey("engine_server", EngineServer)
 
 
 async def handle_query(request: web.Request) -> web.Response:
-    server: EngineServer = request.app[SERVER_KEY]
+    primary: EngineServer = request.app[SERVER_KEY]
+    # ISSUE 14: `server` is rebound to the ROUTED variant's server once
+    # the routing key is known; until then (draining / parse errors) the
+    # primary answers and the outcome is attributed to it.
+    server: EngineServer = primary
     # trace ingress: adopt the client's X-PIO-Request-ID or mint one;
     # the contextvar follows the request through the micro-batcher and
     # into the feedback event (pio_request_id), and every response
@@ -1227,7 +1316,7 @@ async def handle_query(request: web.Request) -> web.Response:
     # this context) marks straight onto it; the batched path's shared
     # stages ride the dispatch BatchClock and merge in at completion.
     wf = sink_token = None
-    if server.instrumentation:
+    if primary.instrumentation:
         wf = Waterfall(rid=rid)
         sink_token = set_stage_sink(wf)
     # the EFFECTIVE query (post brownout clamp) — what capture persists
@@ -1240,6 +1329,8 @@ async def handle_query(request: web.Request) -> web.Response:
         wall = time.perf_counter() - t0
         _M_SERVE.record(wall)
         _M_QUERIES.inc(status=status_label)
+        # per-variant outcome series rides the primary's router table
+        primary.variants.count_query(server.variant_id, status_label)
         # SLO accounting is always on (independent of the waterfall
         # switch): latency objective sees the client-observed wall;
         # availability counts server-side failures (5xx) as bad
@@ -1249,17 +1340,22 @@ async def handle_query(request: web.Request) -> web.Response:
             wf.finish(status_label)
             wf.meta["http"] = status
             wf.meta["mode"] = server.mode
+            wf.meta["variant"] = server.variant_id
             server.flight.record(wf.to_dict())
         trace_event("serve.ingress", status=status_label,
                     http=status, ms=round((time.perf_counter() - t0) * 1e3, 3))
         headers = {TRACE_HEADER: rid}
-        # ISSUE 13: every response names exactly what served it
+        # ISSUE 13: every response names exactly what served it — the
+        # ROUTED variant's envelope (carries variantId, ISSUE 14)
         try:
             headers[PROVENANCE_HEADER] = server.provenance_header()
         except Exception:  # noqa: BLE001 — provenance must not 500 a query
             pass
-        if server.capture is not None and eff_query is not None:
-            server.capture.record(
+        # capture rides the primary's ring (one journal per process) but
+        # persists the routed variant's provenance, so replay can re-pin
+        # each record to the variant that answered it
+        if primary.capture is not None and eff_query is not None:
+            primary.capture.record(
                 rid=rid, request=eff_query, response=body, status=status,
                 latency_ms=wall * 1e3, provenance=server.provenance())
         if retry_after_s is not None:
@@ -1268,13 +1364,32 @@ async def handle_query(request: web.Request) -> web.Response:
             headers["Retry-After"] = f"{max(0.0, retry_after_s):.3f}"
         return web.json_response(body, status=status, headers=headers)
 
-    if server.draining:
+    if primary.draining:
         return _done("draining",
                      {"message": "Server is draining; not accepting queries."},
                      503)
+    try:
+        query_json = await request.json()
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        return _done("bad_request", {"message": "Malformed JSON body."}, 400)
+    if not isinstance(query_json, dict):
+        return _done("bad_request",
+                     {"message": "Query must be a JSON object."}, 400)
+    # ISSUE 14: pick the serving variant — forced by header (replay,
+    # debugging; unknown names fail loud) or hashed on the entity id so
+    # a user sticks to one variant between weight changes
+    forced = request.headers.get(VARIANT_HEADER)
+    try:
+        entry, _how = primary.variants.route(
+            entity_key(query_json), forced=forced)
+    except KeyError:
+        return _done("bad_request",
+                     {"message": f"unknown variant {forced!r}"}, 400)
+    server = entry.server
     if server.admission is not None:
         # adaptive admission (ISSUE 6): shed at ingress with 429 +
-        # Retry-After before the request can pay the queue just to 504
+        # Retry-After before the request can pay the queue just to 504.
+        # Per-variant (ISSUE 14): an overloaded candidate sheds alone.
         client_key = (request.query.get("accessKey")
                       or request.headers.get("X-PIO-Access-Key")
                       or (request.remote or "unknown"))
@@ -1285,13 +1400,6 @@ async def handle_query(request: web.Request) -> web.Response:
                          {"message": f"overloaded; retry later "
                                      f"({decision.reason})"},
                          429, retry_after_s=decision.retry_after_s)
-    try:
-        query_json = await request.json()
-    except (json.JSONDecodeError, UnicodeDecodeError):
-        return _done("bad_request", {"message": "Malformed JSON body."}, 400)
-    if not isinstance(query_json, dict):
-        return _done("bad_request",
-                     {"message": "Query must be a JSON object."}, 400)
     # body parsed + admission decided: everything since ingress is the
     # admission stage; the batcher (or fallback path) owns time from here
     mark_stage("admission")
@@ -1366,11 +1474,25 @@ async def handle_stats_json(request: web.Request) -> web.Response:
 
 async def handle_reload(request: web.Request) -> web.Response:
     server: EngineServer = request.app[SERVER_KEY]
-    try:
-        iid = await asyncio.to_thread(server.reload_latest)
-    except Exception as e:  # noqa: BLE001
-        return web.json_response({"message": str(e)}, status=500)
-    return web.json_response({"message": "Reloaded", "engineInstanceId": iid})
+    # ISSUE 14: a full reload reconciles EVERY non-retired variant — each
+    # variant reloads its own (engine_id, version, variant) triple and
+    # re-applies its own surviving delta patches
+    reloaded: dict[str, str] = {}
+    for e in server.variants.entries():
+        if e.state == "retired":
+            continue
+        try:
+            reloaded[e.variant_id] = await asyncio.to_thread(
+                e.server.reload_latest)
+        except Exception as exc:  # noqa: BLE001
+            return web.json_response(
+                {"message": str(exc), "variant": e.variant_id}, status=500)
+    body = {"message": "Reloaded",
+            "engineInstanceId": reloaded.get(
+                server.variant_id, next(iter(reloaded.values()), None))}
+    if len(reloaded) > 1:
+        body["variants"] = reloaded
+    return web.json_response(body)
 
 
 async def handle_reload_delta(request: web.Request) -> web.Response:
@@ -1378,11 +1500,17 @@ async def handle_reload_delta(request: web.Request) -> web.Response:
     (ISSUE 10): ``{"users": {user_id: [factor]}}`` hot-patches user-side
     factors copy-on-write under the reload lock. Item factors are never
     touched, so the ANN index and compiled retrieval programs stay
-    valid; unseen users are appended (bounded by the patch table)."""
-    server: EngineServer = request.app[SERVER_KEY]
+    valid; unseen users are appended (bounded by the patch table).
+
+    ISSUE 14: an optional ``"variant"`` field routes the patch to that
+    variant's OWN bounded patch table; unknown or retired variants are
+    rejected 400 (counted) — a delta must never silently land on
+    whatever bundle happens to be live. Without the field the patch
+    goes to the live variant (single-variant behavior unchanged)."""
+    primary: EngineServer = request.app[SERVER_KEY]
     rid = ensure_request_id(request.headers.get(TRACE_HEADER))
     headers = {TRACE_HEADER: rid}
-    if server.draining:
+    if primary.draining:
         _M_DELTA.inc(status="draining")
         return web.json_response(
             {"message": "Server is draining; not accepting patches."},
@@ -1399,6 +1527,25 @@ async def handle_reload_delta(request: web.Request) -> web.Response:
         return web.json_response(
             {"message": 'Body must be {"users": {user_id: [factor, ...]}}.'},
             status=400, headers=headers)
+    vid = body.get("variant") if isinstance(body, dict) else None
+    if vid is not None:
+        entry = primary.variants.get(str(vid))
+        if entry is None:
+            _M_DELTA.inc(status="bad_request")
+            primary.variants.count_delta_rejected(str(vid), "unknown")
+            return web.json_response(
+                {"message": f"unknown variant {vid!r}"},
+                status=400, headers=headers)
+        if entry.state == "retired":
+            _M_DELTA.inc(status="bad_request")
+            primary.variants.count_delta_rejected(str(vid), "retired")
+            return web.json_response(
+                {"message": f"variant {vid!r} is retired"},
+                status=400, headers=headers)
+        server = entry.server
+    else:
+        live = primary.variants.live()
+        server = live.server if live is not None else primary
     try:
         out = await asyncio.to_thread(server.apply_delta, users)
     except Exception as e:  # noqa: BLE001 — publish path must see a 500
@@ -1406,10 +1553,17 @@ async def handle_reload_delta(request: web.Request) -> web.Response:
         _M_DELTA.inc(status="error")
         return web.json_response({"message": str(e)}, status=500,
                                  headers=headers)
+    gate = body.get("gate")
+    if isinstance(gate, dict):
+        # the publisher's latest eval-gate hit@k rides along with the
+        # patch; keep it on the variant it was measured FOR
+        server.last_stream_gate = gate
     _M_DELTA.inc(status="ok" if out["appliedCount"] else "empty")
     trace_event("serve.delta", users=out["appliedCount"],
-                epoch=out["epoch"])
-    return web.json_response({"message": "Patched", **out}, headers=headers)
+                epoch=out["epoch"], variant=server.variant_id)
+    return web.json_response(
+        {"message": "Patched", "variant": server.variant_id, **out},
+        headers=headers)
 
 
 async def handle_health(request: web.Request) -> web.Response:
@@ -1418,6 +1572,20 @@ async def handle_health(request: web.Request) -> web.Response:
     draining so a load balancer rotates it out before exit."""
     server: EngineServer = request.app[SERVER_KEY]
     body = server.health()
+    # ISSUE 14: per-variant liveness — each co-hosted variant's mode,
+    # SLO posture and patch epoch, keyed for the triage queries in the
+    # multi-variant runbook
+    if len(server.variants) > 1:
+        body["variants"] = {
+            e.variant_id: {
+                "state": e.state,
+                "weight": e.weight,
+                "mode": e.server.mode,
+                "engineInstanceId": e.server.engine_instance_id,
+                "patchEpoch": e.server.patch_epoch,
+                "slo": e.server.slo.summary(),
+            }
+            for e in server.variants.entries()}
     return web.json_response(body, status=503 if server.draining else 200)
 
 
@@ -1494,6 +1662,176 @@ async def handle_capture_stop(request: web.Request) -> web.Response:
                               "capture": server.capture.stats()})
 
 
+async def handle_variants(request: web.Request) -> web.Response:
+    """GET /variants.json — the variant table: lifecycle state, weight,
+    normalized traffic share and routed-query counts per variant."""
+    server: EngineServer = request.app[SERVER_KEY]
+    return web.json_response(server.variants.snapshot())
+
+
+async def handle_variant_register(request: web.Request) -> web.Response:
+    """POST /variants — register another trained engine variant into
+    THIS process (``pio deploy --variant-of`` lands here). The bundle
+    must rehydrate inside the serving process, so the body names what to
+    load (engineDir [+ engineJson] or a pinned engineInstanceId) and the
+    server does the deploy work itself; the new variant starts as a
+    ``candidate`` with the given traffic weight."""
+    primary: EngineServer = request.app[SERVER_KEY]
+    try:
+        body = await request.json()
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        return web.json_response({"message": "Malformed JSON body."},
+                                 status=400)
+    if not isinstance(body, dict):
+        return web.json_response({"message": "Body must be an object."},
+                                 status=400)
+    vid = str(body.get("variantId") or "").strip()
+    if not vid:
+        return web.json_response({"message": "variantId is required."},
+                                 status=400)
+    if primary.variants.get(vid) is not None:
+        return web.json_response(
+            {"message": f"variant {vid!r} already registered"}, status=409)
+    engine_dir = body.get("engineDir")
+    if not engine_dir:
+        return web.json_response({"message": "engineDir is required."},
+                                 status=400)
+    try:
+        weight = float(body.get("weight", 0.0))
+    except (TypeError, ValueError):
+        return web.json_response({"message": "weight must be a number."},
+                                 status=400)
+
+    def _build() -> EngineServer:
+        from pathlib import Path
+
+        from .core_workflow import resolve_engine_factory
+
+        edir = Path(engine_dir)
+        variant_json = edir / (body.get("engineJson") or "engine.json")
+        variant = json.loads(variant_json.read_text())
+        factory = variant.get("engineFactory")
+        if not factory:
+            raise ValueError(f"{variant_json} has no engineFactory field")
+        engine = resolve_engine_factory(factory, engine_dir=edir)
+        meta = Storage.get_metadata()
+        pinned = body.get("engineInstanceId")
+        if pinned:
+            inst = meta.engine_instance_get(str(pinned))
+            if inst is None:
+                raise LookupError(f"engine instance {pinned!r} not found")
+        else:
+            engine_id = variant.get("id") or edir.resolve().name
+            version = str(variant.get("version", "1"))
+            meta_variant = str(variant.get("variantId", "default"))
+            inst = meta.engine_instance_get_latest_completed(
+                engine_id, version, meta_variant)
+            if inst is None:
+                raise LookupError(
+                    f"no COMPLETED training of engine {engine_id} found")
+        return EngineServer(
+            engine, inst,
+            variant_id=vid,
+            engine_dir=edir,
+            fallback=not pinned,
+            batch_window_ms=float(body.get("batchWindowMs", 1.0)),
+            batch_max=int(body.get("batchMax", primary.batch_max)),
+            batch_inflight=int(body.get("batchInflight", 8)),
+            deadline_ms=float(body.get("deadlineMs", primary.deadline_ms)),
+            admission=bool(body.get("admission", False)),
+            admission_queue_high=int(body.get("admissionQueueHigh", 64)),
+            admission_wait_budget_ms=float(
+                body.get("admissionWaitBudgetMs", 0.0)),
+            rate_limit_qps=float(body.get("rateLimitQps", 0.0)),
+            rate_limit_burst=float(body.get("rateLimitBurst", 0.0)),
+            brownout_topk=int(body.get("brownoutTopk", 10)),
+            slo_latency_ms=float(body.get("sloLatencyMs", 0.0)),
+            patch_table_max=int(
+                body.get("patchTableMax", primary.patch_table_max)),
+            retrieval=(body.get("retrieval")
+                       if isinstance(body.get("retrieval"), dict) else None),
+            instrumentation=primary.instrumentation,
+        )
+
+    try:
+        child = await asyncio.to_thread(_build)
+    except (LookupError, FileNotFoundError) as e:
+        return web.json_response({"message": str(e)}, status=404)
+    except Exception as e:  # noqa: BLE001 — registration must not 500-loop
+        log.exception("variant registration failed")
+        return web.json_response({"message": str(e)}, status=400)
+    # the child's ctor pointed the shared flight recorder's ambient
+    # context at itself; the app's primary stays authoritative
+    primary.flight.set_context_provider(primary._flight_context)
+    try:
+        entry = primary.variants.register(vid, child, weight=weight)
+    except ValueError as e:
+        return web.json_response({"message": str(e)}, status=409)
+    log.info("registered variant %r (instance %s, weight %s)",
+             vid, child.engine_instance_id, weight)
+    return web.json_response({"message": "Registered", **entry.snapshot()})
+
+
+async def handle_variant_weight(request: web.Request) -> web.Response:
+    """POST /variants/{vid}/weight — body ``{"weight": W}``. Only the
+    two hash buckets whose relative weight changed re-shuffle users
+    (rendezvous hashing); everyone else keeps their variant."""
+    server: EngineServer = request.app[SERVER_KEY]
+    vid = request.match_info["vid"]
+    try:
+        body = await request.json()
+        weight = float(body["weight"])
+    except (json.JSONDecodeError, UnicodeDecodeError, KeyError, TypeError,
+            ValueError):
+        return web.json_response(
+            {"message": 'Body must be {"weight": <number>}.'}, status=400)
+    try:
+        entry = server.variants.set_weight(vid, weight)
+    except KeyError:
+        return web.json_response({"message": f"unknown variant {vid!r}"},
+                                 status=404)
+    except ValueError as e:
+        return web.json_response({"message": str(e)}, status=400)
+    return web.json_response({"message": "Weight set", **entry.snapshot()})
+
+
+async def handle_variant_promote(request: web.Request) -> web.Response:
+    """POST /variants/{vid}/promote — candidate becomes live, swapping
+    weights with the previous live variant. Purely a routing-table flip:
+    both bundles stay deployed, in-flight requests finish on whichever
+    variant admitted them."""
+    server: EngineServer = request.app[SERVER_KEY]
+    vid = request.match_info["vid"]
+    try:
+        out = server.variants.promote(vid)
+    except KeyError:
+        return web.json_response({"message": f"unknown variant {vid!r}"},
+                                 status=404)
+    except ValueError as e:
+        return web.json_response({"message": str(e)}, status=400)
+    log.info("promoted variant %r (previous live: %s)",
+             vid, out.get("previousLive"))
+    return web.json_response({"message": "Promoted", **out,
+                              "variants": server.variants.snapshot()})
+
+
+async def handle_variant_retire(request: web.Request) -> web.Response:
+    """POST /variants/{vid}/retire — take a candidate out of rotation.
+    The bundle stays resident (forced-header routing still reaches it
+    for replay) until the process restarts without it."""
+    server: EngineServer = request.app[SERVER_KEY]
+    vid = request.match_info["vid"]
+    try:
+        entry = server.variants.retire(vid)
+    except KeyError:
+        return web.json_response({"message": f"unknown variant {vid!r}"},
+                                 status=404)
+    except ValueError as e:
+        return web.json_response({"message": str(e)}, status=400)
+    log.info("retired variant %r", vid)
+    return web.json_response({"message": "Retired", **entry.snapshot()})
+
+
 async def handle_stop(request: web.Request) -> web.Response:
     server: EngineServer = request.app[SERVER_KEY]
 
@@ -1528,20 +1866,36 @@ def create_engine_server_app(server: EngineServer) -> web.Application:
     app.router.add_post("/debug/profile", handle_profile)
     app.router.add_post("/capture/start", handle_capture_start)
     app.router.add_post("/capture/stop", handle_capture_stop)
+    # ISSUE 14: variant lifecycle — register / list / weight / promote /
+    # retire N co-hosted engine variants on one device pool
+    app.router.add_get("/variants.json", handle_variants)
+    app.router.add_post("/variants", handle_variant_register)
+    app.router.add_post("/variants/{vid}/weight", handle_variant_weight)
+    app.router.add_post("/variants/{vid}/promote", handle_variant_promote)
+    app.router.add_post("/variants/{vid}/retire", handle_variant_retire)
     app.router.add_get("/stop", handle_stop)
+
+    def _variant_servers():
+        # stub servers in tests may carry no VariantTable at all
+        table = getattr(server, "variants", None)
+        return table.servers() if table is not None else [server]
 
     async def _drain_server(app):
         # graceful drain on ANY teardown (SIGTERM -> run_app's
         # GracefulExit, /stop, test cleanup): flush queued queries,
         # finish in-flight batches, close the feedback session.
         # server.drain() is idempotent — /stop may already have run it.
-        await server.drain()
+        # Every registered variant drains (the primary is in its own
+        # table), so in-flight requests on candidates finish too.
+        for s in _variant_servers():
+            await s.drain()
 
     async def _close_batcher(app):
         # after drain, stop the dispatcher loop so nothing leaks; any
         # future still pending at this point gets CancelledError
-        if server.batcher is not None:
-            await server.batcher.close()
+        for s in _variant_servers():
+            if s.batcher is not None:
+                await s.batcher.close()
 
     app.on_shutdown.append(_drain_server)
     app.on_cleanup.append(_close_batcher)

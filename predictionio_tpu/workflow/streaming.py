@@ -188,6 +188,7 @@ class StreamingUpdater:
         backoff_base_s: float = 0.5,
         backoff_cap_s: float = 30.0,
         publish_timeout_s: float = 10.0,
+        variant: str | None = None,
         rng: random.Random | None = None,
     ):
         # deferred: storage.journal itself imports workflow.faults, so a
@@ -208,6 +209,11 @@ class StreamingUpdater:
         self.backoff_base_s = max(0.0, backoff_base_s)
         self.backoff_cap_s = backoff_cap_s
         self.publish_timeout_s = publish_timeout_s
+        # ISSUE 14: which serving variant this updater feeds. Stamped
+        # into every /reload/delta payload so a multi-variant server
+        # routes the patch to the right bounded table; None preserves
+        # the single-variant behavior (patch lands on the live variant).
+        self.variant = variant
         self._rng = rng or random.Random()
         self._stop = threading.Event()
         # counters mirrored into stats() for tests and `pio stream` logs
@@ -339,7 +345,17 @@ class StreamingUpdater:
     # -- publish path ------------------------------------------------------
     def _post(self, patches: dict[str, list[float]],
               trace: str | None) -> dict:
-        body = json.dumps({"users": patches}).encode()
+        payload: dict = {"users": patches}
+        if self.variant is not None:
+            # ISSUE 14: target variant. The server 400s (fatal here — no
+            # point replaying) when the variant is unknown or retired.
+            payload["variant"] = self.variant
+        if self.last_gate is not None:
+            # ride the latest eval-gate hit@k along: the server keeps it
+            # per variant, so the dashboard's A/B view can show each
+            # variant's online quality next to its traffic share
+            payload["gate"] = {**self.last_gate, "k": self.eval_k}
+        body = json.dumps(payload).encode()
         headers = {"Content-Type": "application/json"}
         if trace:
             headers[TRACE_HEADER] = trace
@@ -500,6 +516,7 @@ class StreamingUpdater:
 
     def stats(self) -> dict:
         return {
+            "variant": self.variant,
             "cycles": self.cycles,
             "eventsSeen": self.events_seen,
             "eventsSkipped": self.events_skipped,

@@ -1,0 +1,375 @@
+"""Multi-variant serving: N engine variants on one device pool.
+
+The reference PredictionIO deployed many engine variants per server
+(engine variants + channels fed the dashboard's A/B view); our engine
+server hosted exactly one engine per process. This module closes that
+gap: a :class:`VariantTable` registers N fully-deployed engine variants
+inside ONE engine-server process — one aiohttp app, one device pool,
+one process-wide ExecutableCache — and routes each query to a variant
+by a **deterministic hash of the query's entity id** into the
+configured traffic weights.
+
+Routing is *weighted rendezvous hashing* (highest-random-weight): per
+(variant, key) pair we draw a uniform ``u`` from a keyed blake2b digest
+and score the variant ``-weight / ln(u)``; the highest score wins.
+Properties that matter for experimentation:
+
+- **Deterministic & stateless** — the same key and the same weights
+  always land on the same variant, across processes and restarts, so a
+  user's experience is sticky between weight changes and a
+  weight-preserving reload re-buckets nobody.
+- **Proportional** — the win probability of a variant is exactly its
+  weight share (the rendezvous construction, Thaler & Ravishankar).
+- **Minimal disruption** — changing one variant's weight only moves
+  keys between that variant and the others; keys whose winner did not
+  change keep their assignment (consistent-hashing property).
+
+Each variant is a full ``EngineServer`` (its own microbatcher,
+AdmissionController plane, SLO tracker, delta patch table, provenance
+cache) registered under a lifecycle state ``candidate → live →
+retired``. Device-side state is the part deliberately NOT per-variant:
+every variant's retrievers share the process ExecutableCache, so N
+same-shaped variants compile their top-k/ANN programs once.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Iterable
+
+from ..obs.metrics import METRICS
+
+__all__ = [
+    "VARIANT_HEADER",
+    "VARIANT_STATES",
+    "entity_key",
+    "bucket_for",
+    "VariantEntry",
+    "VariantTable",
+]
+
+#: Forced-routing override header: bypasses the hash and pins the
+#: request to the named variant (capture/replay, debugging, smoke
+#: tests). Unknown names 400 rather than falling through to the hash —
+#: a replay that silently lands on the wrong variant is worse than one
+#: that fails loudly.
+VARIANT_HEADER = "X-PIO-Variant"
+
+#: Lifecycle states, in promotion order.
+VARIANT_STATES: tuple[str, ...] = ("candidate", "live", "retired")
+
+_STATE_LEVELS = {"candidate": 0, "live": 1, "retired": 2}
+
+#: Query fields probed (in order) for the sticky-routing entity id.
+_ENTITY_FIELDS: tuple[str, ...] = (
+    "user", "userId", "user_id", "uid", "entityId", "id")
+
+_M_ROUTED = METRICS.counter(
+    "pio_serve_routed_total",
+    "queries routed to a variant, by mechanism "
+    "(hashed / forced header / single-variant default)",
+    labelnames=("variant", "how"))
+_M_VQUERIES = METRICS.counter(
+    "pio_serve_queries_total",
+    "per-variant query outcomes (same status vocabulary as "
+    "pio_queries_total)",
+    labelnames=("variant", "status"))
+_M_WEIGHT = METRICS.gauge(
+    "pio_variant_weight",
+    "configured traffic weight per variant (normalized share is "
+    "weight / sum over non-retired variants)",
+    labelnames=("variant",))
+_M_STATE = METRICS.gauge(
+    "pio_variant_state",
+    "variant lifecycle: 0 candidate, 1 live, 2 retired",
+    labelnames=("variant",))
+_M_DELTA_REJECTED = METRICS.counter(
+    "pio_variant_delta_rejected_total",
+    "delta patches rejected at /reload/delta because the stamped "
+    "variant is unknown or retired",
+    labelnames=("variant", "reason"))
+
+
+def entity_key(query: Any) -> str:
+    """Stable routing key for a query dict.
+
+    Prefers the first present entity-id field (``user``, ``userId``,
+    …); a query with no entity id hashes its canonical JSON so the
+    *same* query is still sticky even when anonymous.
+    """
+    if isinstance(query, dict):
+        for f in _ENTITY_FIELDS:
+            v = query.get(f)
+            if isinstance(v, (str, int)) and not isinstance(v, bool):
+                return str(v)
+    import json
+
+    try:
+        return json.dumps(query, sort_keys=True, separators=(",", ":"),
+                          default=str)
+    except (TypeError, ValueError):
+        return repr(query)
+
+
+def _uniform(vid: str, key: str) -> float:
+    """Keyed uniform draw in (0, 1] for one (variant, key) pair."""
+    h = hashlib.blake2b(f"{vid}\x00{key}".encode("utf-8", "replace"),
+                        digest_size=8).digest()
+    return (int.from_bytes(h, "big") + 1) / (2**64 + 1)
+
+
+def bucket_for(key: str, weights: dict[str, float]) -> str:
+    """Weighted rendezvous hash: pick one variant id for ``key``.
+
+    Variants with weight <= 0 never win. Raises ``ValueError`` when no
+    variant has positive weight — the table guarantees this cannot
+    happen for a live table (the live variant always has weight > 0 or
+    is the only entry).
+    """
+    best_vid: str | None = None
+    best_score = -math.inf
+    for vid in sorted(weights):
+        w = weights[vid]
+        if w <= 0.0:
+            continue
+        u = _uniform(vid, key)
+        # u == 1.0 is a 1-in-2^64 draw; -w/ln(1) would divide by zero.
+        score = math.inf if u >= 1.0 else -w / math.log(u)
+        if score > best_score:
+            best_score = score
+            best_vid = vid
+    if best_vid is None:
+        raise ValueError("no variant with positive weight")
+    return best_vid
+
+
+@dataclass
+class VariantEntry:
+    """One registered variant: a full EngineServer plus routing state."""
+
+    variant_id: str
+    server: Any  # EngineServer; Any avoids a circular import
+    state: str = "candidate"
+    weight: float = 0.0
+    registered_at: float = field(default_factory=time.time)
+
+    def snapshot(self) -> dict:
+        return {
+            "variantId": self.variant_id,
+            "state": self.state,
+            "weight": self.weight,
+            "registeredAt": self.registered_at,
+            "engineInstanceId": getattr(
+                self.server, "engine_instance_id", None),
+        }
+
+
+class VariantTable:
+    """Registry + router for the variants hosted by one server process.
+
+    Thread-safe: routing runs on the event loop while lifecycle
+    operations (register/weight/promote/retire) arrive from management
+    endpoints, possibly via ``asyncio.to_thread``. Routing reads take a
+    consistent snapshot of ``(weights, entries)`` under the lock and
+    hash outside it.
+    """
+
+    def __init__(self, default_vid: str, primary_server: Any):
+        self._lock = threading.Lock()
+        self._entries: dict[str, VariantEntry] = {}
+        e = VariantEntry(default_vid, primary_server,
+                         state="live", weight=1.0)
+        self._entries[default_vid] = e
+        self._publish_gauges_locked()
+
+    # -- lifecycle ---------------------------------------------------
+    def register(self, vid: str, server: Any, *,
+                 weight: float = 0.0) -> VariantEntry:
+        if not vid:
+            raise ValueError("variant id must be non-empty")
+        weight = float(weight)
+        if weight < 0.0 or not math.isfinite(weight):
+            raise ValueError(f"weight must be finite and >= 0, got {weight}")
+        with self._lock:
+            if vid in self._entries:
+                raise ValueError(f"variant {vid!r} already registered")
+            e = VariantEntry(vid, server, state="candidate", weight=weight)
+            self._entries[vid] = e
+            self._publish_gauges_locked()
+            return e
+
+    def set_weight(self, vid: str, weight: float) -> VariantEntry:
+        weight = float(weight)
+        if weight < 0.0 or not math.isfinite(weight):
+            raise ValueError(f"weight must be finite and >= 0, got {weight}")
+        with self._lock:
+            e = self._require_locked(vid)
+            if e.state == "retired":
+                raise ValueError(f"variant {vid!r} is retired")
+            if e.state == "live" and weight == 0.0 and len(
+                    [x for x in self._entries.values()
+                     if x.state != "retired"]) > 1:
+                # A weightless live variant would strand sticky users
+                # only reachable via the forced header; shift traffic
+                # with promote() instead.
+                raise ValueError(
+                    "cannot zero the live variant's weight; "
+                    "promote another variant instead")
+            e.weight = weight
+            self._publish_gauges_locked()
+            return e
+
+    def promote(self, vid: str) -> dict:
+        """Make ``vid`` the live variant, swapping weights with the
+        previous live one. Weight-swap (not weight-zero) keeps the
+        total hash mass identical, so ONLY keys belonging to the two
+        swapped variants move — everyone else keeps their assignment.
+        """
+        with self._lock:
+            e = self._require_locked(vid)
+            if e.state == "retired":
+                raise ValueError(f"variant {vid!r} is retired")
+            prev = self._live_locked()
+            if prev is not None and prev.variant_id == vid:
+                return {"promoted": vid, "previousLive": vid}
+            if prev is not None:
+                prev.state = "candidate"
+                prev.weight, e.weight = e.weight, prev.weight
+            e.state = "live"
+            if e.weight <= 0.0 and all(
+                    x.weight <= 0.0 for x in self._entries.values()
+                    if x.state != "retired"):
+                e.weight = 1.0  # never leave the table unroutable
+            self._publish_gauges_locked()
+            return {"promoted": vid,
+                    "previousLive": prev.variant_id if prev else None}
+
+    def retire(self, vid: str) -> VariantEntry:
+        with self._lock:
+            e = self._require_locked(vid)
+            if e.state == "live":
+                raise ValueError(
+                    f"variant {vid!r} is live; promote a replacement first")
+            e.state = "retired"
+            e.weight = 0.0
+            self._publish_gauges_locked()
+            return e
+
+    # -- routing -----------------------------------------------------
+    def route(self, key: str, forced: str | None = None
+              ) -> tuple[VariantEntry, str]:
+        """Pick the serving variant for a routing key.
+
+        Returns ``(entry, how)`` with ``how`` in ``forced`` / ``hashed``
+        / ``default``. A forced name must exist (KeyError otherwise) but
+        MAY be retired — capture/replay needs to re-hit a variant after
+        the experiment ended. Hashed traffic only ever reaches
+        non-retired variants with positive weight.
+        """
+        with self._lock:
+            if forced is not None:
+                e = self._entries.get(forced)
+                if e is None:
+                    raise KeyError(forced)
+                _M_ROUTED.inc(variant=forced, how="forced")
+                return e, "forced"
+            weights = {v.variant_id: v.weight
+                       for v in self._entries.values()
+                       if v.state != "retired" and v.weight > 0.0}
+            if len(weights) <= 1:
+                e = (self._entries[next(iter(weights))] if weights
+                     else self._live_locked() or
+                     next(iter(self._entries.values())))
+                _M_ROUTED.inc(variant=e.variant_id, how="default")
+                return e, "default"
+            entries = dict(self._entries)
+        vid = bucket_for(key, weights)
+        _M_ROUTED.inc(variant=vid, how="hashed")
+        return entries[vid], "hashed"
+
+    def count_query(self, vid: str, status: str) -> None:
+        _M_VQUERIES.inc(variant=vid, status=status)
+
+    def count_delta_rejected(self, vid: str, reason: str) -> None:
+        _M_DELTA_REJECTED.inc(variant=str(vid), reason=reason)
+
+    # -- introspection -----------------------------------------------
+    def get(self, vid: str) -> VariantEntry | None:
+        with self._lock:
+            return self._entries.get(vid)
+
+    def entries(self) -> list[VariantEntry]:
+        with self._lock:
+            return list(self._entries.values())
+
+    def servers(self) -> list[Any]:
+        with self._lock:
+            return [e.server for e in self._entries.values()]
+
+    def live(self) -> VariantEntry | None:
+        with self._lock:
+            return self._live_locked()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def weights(self) -> dict[str, float]:
+        """Routable weight map (non-retired, weight > 0)."""
+        with self._lock:
+            return {v.variant_id: v.weight
+                    for v in self._entries.values()
+                    if v.state != "retired" and v.weight > 0.0}
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            entries = [e.snapshot() for e in self._entries.values()]
+            total = sum(e["weight"] for e in entries
+                        if e["state"] != "retired" and e["weight"] > 0.0)
+        for e in entries:
+            share = (e["weight"] / total
+                     if total > 0.0 and e["state"] != "retired" else 0.0)
+            e["trafficShare"] = share
+            e["routed"] = {
+                how: int(_M_ROUTED.value(e["variantId"], how))
+                for how in ("hashed", "forced", "default")}
+        return {"count": len(entries), "variants": entries}
+
+    # -- internals ---------------------------------------------------
+    def _require_locked(self, vid: str) -> VariantEntry:
+        e = self._entries.get(vid)
+        if e is None:
+            raise KeyError(vid)
+        return e
+
+    def _live_locked(self) -> VariantEntry | None:
+        for e in self._entries.values():
+            if e.state == "live":
+                return e
+        return None
+
+    def _publish_gauges_locked(self) -> None:
+        for e in self._entries.values():
+            _M_WEIGHT.set(e.weight, variant=e.variant_id)
+            _M_STATE.set(_STATE_LEVELS[e.state], variant=e.variant_id)
+
+
+def minimal_disruption(keys: Iterable[str], before: dict[str, float],
+                       after: dict[str, float]) -> dict:
+    """Diagnostic helper: classify how ``keys`` move between two weight
+    maps. Used by tests and the runbook to demonstrate the
+    consistent-hashing property; not on the serving path."""
+    moved: dict[tuple[str, str], int] = {}
+    total = 0
+    for k in keys:
+        total += 1
+        a, b = bucket_for(k, before), bucket_for(k, after)
+        if a != b:
+            moved[(a, b)] = moved.get((a, b), 0) + 1
+    return {"total": total,
+            "moved": sum(moved.values()),
+            "transitions": {f"{a}->{b}": n for (a, b), n in moved.items()}}

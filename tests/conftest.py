@@ -74,6 +74,13 @@ def pytest_configure(config):
         "shares the chaos guard's SIGALRM timeout; select with -m replay")
     config.addinivalue_line(
         "markers",
+        "multiengine: multi-variant serving tests (the VariantTable "
+        "router, hashed A/B splitting, per-variant admission/SLO/delta "
+        "isolation and the variant lifecycle endpoints — "
+        "workflow/variants.py; test_variants.py); shares the chaos "
+        "guard's SIGALRM timeout; select with -m multiengine")
+    config.addinivalue_line(
+        "markers",
         "retrieval: ANN / exact retrieval tests (the quantized IVF index, "
         "its exact-fallback and parity contracts, and the adaptive "
         "shard-count cost model — ops/ann.py, ops/retrieval.py; "
@@ -96,7 +103,8 @@ def _chaos_guard(request):
     if (request.node.get_closest_marker("chaos") is None
             and request.node.get_closest_marker("train_chaos") is None
             and request.node.get_closest_marker("streaming") is None
-            and request.node.get_closest_marker("replay") is None):
+            and request.node.get_closest_marker("replay") is None
+            and request.node.get_closest_marker("multiengine") is None):
         yield
         return
 
@@ -134,7 +142,8 @@ def _multihost_guard(request):
     if (request.node.get_closest_marker("multihost") is None
             or request.node.get_closest_marker("chaos") is not None
             or request.node.get_closest_marker("train_chaos") is not None
-            or request.node.get_closest_marker("streaming") is not None):
+            or request.node.get_closest_marker("streaming") is not None
+            or request.node.get_closest_marker("multiengine") is not None):
         yield
         return
 

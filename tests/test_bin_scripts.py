@@ -188,6 +188,39 @@ def test_pio_admin_reap_help_documents_flags(tmp_path):
         assert flag in out.stdout, f"{flag} missing from admin reap --help"
 
 
+def test_pio_deploy_help_documents_variant_flags(tmp_path):
+    """ISSUE 14: `pio deploy --help` must advertise the co-hosting
+    flags — join an existing server as a variant at a traffic weight."""
+    env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
+    out = subprocess.run([str(REPO / "bin" / "pio"), "deploy", "--help"],
+                         capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    for flag in ("--variant-of", "--weight", "--variant-id"):
+        assert flag in out.stdout, f"{flag} missing from deploy --help"
+
+
+def test_pio_variant_help_documents_subcommands(tmp_path):
+    """ISSUE 14: the variant lifecycle is operator surface — `pio
+    variant --help` must list every lifecycle subcommand the
+    Multi-variant serving runbook documents."""
+    env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
+    out = subprocess.run([str(REPO / "bin" / "pio"), "variant", "--help"],
+                         capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    for sub in ("list", "weight", "promote", "retire"):
+        assert sub in out.stdout, f"{sub} missing from variant --help"
+
+
+def test_pio_stream_help_documents_variant_flag(tmp_path):
+    """ISSUE 14 satellite: the streaming updater stamps its target
+    variant; the flag must be on the CLI surface."""
+    env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
+    out = subprocess.run([str(REPO / "bin" / "pio"), "stream", "--help"],
+                         capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    assert "--variant" in out.stdout
+
+
 def test_pio_stream_help_documents_updater_flags(tmp_path):
     """ISSUE 10: the streaming updater's operator surface — `pio stream
     --help` must advertise the journal-tailing, gating and publish

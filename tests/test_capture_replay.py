@@ -196,6 +196,39 @@ def test_replay_report_shape_and_skips():
         replay_records(records, target="http://x", server=_Stub())
 
 
+def test_replay_groups_parity_by_capture_variant():
+    """One capture of A/B traffic yields per-variant parity blocks: the
+    report's ``variants`` section groups tiers by the variantId stamped
+    in each record's provenance at capture time (absent → default)."""
+    class _Stub:
+        def serve_query(self, q):
+            return _scores(("i1", 2.0))
+
+        def provenance(self):
+            return {"mode": "normal"}
+
+    def _rec(rid, vid, response):
+        prov = {"variantId": vid} if vid else {}
+        return {"rid": rid, "request": {"user": rid}, "status": 200,
+                "response": response, "provenance": prov}
+
+    records = [
+        _rec("a1", "a", _scores(("i1", 2.0))),      # bitwise
+        _rec("a2", "a", _scores(("i9", 9.0))),      # mismatch
+        _rec("b1", "b", _scores(("i1", 2.0))),      # bitwise
+        _rec("d1", None, _scores(("i1", 2.0))),     # no variantId stamped
+    ]
+    rep = replay_records(records, server=_Stub())
+    assert set(rep["variants"]) == {"a", "b", "default"}
+    va, vb = rep["variants"]["a"], rep["variants"]["b"]
+    assert va["total"] == 2 and va["tiers"]["bitwise"] == 1 \
+        and va["tiers"]["mismatch"] == 1 and va["parityPct"] == 50.0
+    assert vb["total"] == 1 and vb["parityPct"] == 100.0
+    assert rep["variants"]["default"]["parityPct"] == 100.0
+    # grouped counts must reconcile with the flat tier totals
+    assert sum(v["total"] for v in rep["variants"].values()) == rep["total"]
+
+
 # ---------------------------------------------------------------------------
 # satellite 1: X-PIO-Request-ID on every response from every app
 

@@ -68,8 +68,8 @@ def test_two_engines_train_and_serve_in_one_process(tmp_path):
     assert pio(["train", "--engine-dir", str(d_b)]) == 0
 
     meta = Storage.get_metadata()
-    inst_a = meta.engine_instance_get_completed("multia", "1", "multia")[0]
-    inst_b = meta.engine_instance_get_completed("multib", "1", "multib")[0]
+    inst_a = meta.engine_instance_get_completed("multia", "1", "default")[0]
+    inst_b = meta.engine_instance_get_completed("multib", "1", "default")[0]
 
     from predictionio_tpu.workflow.create_server import (
         EngineServer,
@@ -231,7 +231,7 @@ def test_model_blob_survives_moved_engine_dir(tmp_path):
     _import_events("movedapp", tmp_path, [10.0, 30.0])  # avg 20
     assert pio(["train", "--engine-dir", str(d1)]) == 0
     inst = Storage.get_metadata().engine_instance_get_completed(
-        "movedapp", "1", "movedapp")[0]
+        "movedapp", "1", "default")[0]
 
     # move the dir and simulate a fresh process: drop every scoped module
     d2 = tmp_path / "relocated"

@@ -1,0 +1,542 @@
+"""Multi-variant serving (ISSUE 14): hashed A/B splitting, the
+VariantTable lifecycle, per-variant delta isolation and the e2e
+acceptance path — two distinguishable variants co-hosted in ONE engine
+server process at an 80/20 split.
+
+Covers:
+- ``workflow/variants.py`` — weighted rendezvous hashing (distribution,
+  stickiness, minimal re-bucketing), entity-key extraction, the
+  register/weight/promote/retire lifecycle rules.
+- ``workflow/create_server.py`` — routed /queries.json with the
+  ``X-PIO-Variant`` override, per-variant /reload/delta isolation,
+  per-variant admission shedding, the /variants management endpoints
+  and the per-variant /stats.json + /health.json blocks.
+- ``tools/cli.py`` — ``_engine_ids`` honoring ``variantId`` and the
+  ``pio variant`` subcommands against a live server.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+import requests
+
+from predictionio_tpu.workflow.variants import (
+    VariantTable,
+    bucket_for,
+    entity_key,
+    minimal_disruption,
+)
+
+pytestmark = pytest.mark.multiengine
+
+
+# ---------------------------------------------------------------------------
+# Hashed splitting: the pure-function properties
+
+
+def _keys(n, prefix="u"):
+    return [f"{prefix}{i}" for i in range(n)]
+
+
+def test_split_distribution_within_2pct():
+    """100k synthetic entity ids at 80/20 land within ±2% absolute of
+    the configured split (acceptance criterion; measured margin is
+    ~0.1%, so 2% has huge headroom)."""
+    weights = {"champion": 0.8, "challenger": 0.2}
+    counts = {"champion": 0, "challenger": 0}
+    for k in _keys(100_000):
+        counts[bucket_for(k, weights)] += 1
+    assert counts["champion"] / 100_000 == pytest.approx(0.8, abs=0.02)
+    assert counts["challenger"] / 100_000 == pytest.approx(0.2, abs=0.02)
+
+
+def test_split_sticky_across_rebuilds():
+    """Same weights -> identical assignment, regardless of dict
+    insertion order or recomputation (a weight-preserving reload
+    re-buckets nobody)."""
+    keys = _keys(5_000)
+    w1 = {"a": 0.8, "b": 0.2}
+    w2 = {"b": 0.2, "a": 0.8}  # same weights, different insertion order
+    first = [bucket_for(k, w1) for k in keys]
+    assert [bucket_for(k, w2) for k in keys] == first
+    assert [bucket_for(k, dict(w1)) for k in keys] == first
+
+
+def test_weight_change_moves_minimal_keys():
+    """0.8/0.2 -> 0.7/0.3 moves ~10% of keys and ONLY from the shrunk
+    variant to the grown one — nobody bounces a->b->a (consistent-
+    hashing property the runbook relies on)."""
+    keys = _keys(20_000)
+    out = minimal_disruption(keys, {"a": 0.8, "b": 0.2},
+                             {"a": 0.7, "b": 0.3})
+    assert out["total"] == 20_000
+    frac = out["moved"] / out["total"]
+    assert 0.05 < frac < 0.15  # expected 0.10
+    assert set(out["transitions"]) == {"a->b"}
+
+
+def test_new_variant_only_steals_keys():
+    """Adding a third variant at weight w only moves keys INTO it;
+    existing a/b assignments otherwise hold."""
+    keys = _keys(10_000)
+    before = {"a": 0.5, "b": 0.5}
+    after = {"a": 0.5, "b": 0.5, "c": 0.25}
+    out = minimal_disruption(keys, before, after)
+    assert set(out["transitions"]) <= {"a->c", "b->c"}
+    # c's share is 0.25/1.25 = 20%
+    assert out["moved"] / out["total"] == pytest.approx(0.2, abs=0.02)
+
+
+def test_bucket_for_edges():
+    assert bucket_for("k1", {"only": 1.0}) == "only"
+    # zero-weight variants never win
+    assert all(bucket_for(k, {"a": 1.0, "z": 0.0}) == "a"
+               for k in _keys(100))
+    with pytest.raises(ValueError):
+        bucket_for("k1", {"a": 0.0})
+    with pytest.raises(ValueError):
+        bucket_for("k1", {})
+
+
+def test_entity_key_extraction():
+    assert entity_key({"user": "u7", "day": "Mon"}) == "u7"
+    assert entity_key({"userId": 42}) == "42"
+    assert entity_key({"entityId": "e1", "id": "ignored"}) == "e1"
+    # bools are ints in Python but NOT entity ids
+    assert entity_key({"user": True, "id": "real"}) == "real"
+    # anonymous queries: canonical JSON keeps the same query sticky
+    k1 = entity_key({"day": "Mon", "k": 1})
+    k2 = entity_key({"k": 1, "day": "Mon"})
+    assert k1 == k2
+    assert entity_key({"day": "Tue"}) != k1
+
+
+# ---------------------------------------------------------------------------
+# VariantTable lifecycle rules (no server needed — the table only
+# stores the server object)
+
+
+def _table():
+    return VariantTable("default", object())
+
+
+def test_table_register_and_weights():
+    t = _table()
+    live = t.get("default")
+    assert live.state == "live" and live.weight == 1.0
+    t.register("cand", object(), weight=0.25)
+    assert t.get("cand").state == "candidate"
+    assert t.weights() == {"default": 1.0, "cand": 0.25}
+    with pytest.raises(ValueError):
+        t.register("cand", object())  # duplicate
+    with pytest.raises(ValueError):
+        t.register("", object())
+    with pytest.raises(ValueError):
+        t.register("neg", object(), weight=-1.0)
+    with pytest.raises(ValueError):
+        t.register("nan", object(), weight=float("nan"))
+
+
+def test_table_set_weight_rules():
+    t = _table()
+    t.register("cand", object(), weight=0.2)
+    t.set_weight("default", 0.8)
+    assert t.weights() == {"default": 0.8, "cand": 0.2}
+    with pytest.raises(KeyError):
+        t.set_weight("nope", 0.5)
+    # zeroing the live variant while others are routable is refused
+    with pytest.raises(ValueError):
+        t.set_weight("default", 0.0)
+    t.retire("cand")
+    with pytest.raises(ValueError):
+        t.set_weight("cand", 0.5)  # retired stays retired
+    # sole remaining variant MAY go to zero (single-variant table
+    # routes by default, not by hash)
+    t.set_weight("default", 0.0)
+    e, how = t.route("u1")
+    assert e.variant_id == "default" and how == "default"
+
+
+def test_table_promote_swaps_weights_and_states():
+    t = _table()
+    t.register("cand", object(), weight=0.2)
+    t.set_weight("default", 0.8)
+    out = t.promote("cand")
+    assert out == {"promoted": "cand", "previousLive": "default"}
+    assert t.get("cand").state == "live" and t.get("cand").weight == 0.8
+    assert (t.get("default").state == "candidate"
+            and t.get("default").weight == 0.2)
+    # promoting the live variant is a no-op
+    assert t.promote("cand")["previousLive"] == "cand"
+    # promoting a zero-weight candidate inherits the live weight (the
+    # swap), so the table never goes unroutable
+    t2 = _table()
+    t2.register("c2", object(), weight=0.0)
+    t2.promote("c2")
+    assert t2.get("c2").state == "live" and t2.get("c2").weight > 0.0
+
+
+def test_table_retire_rules():
+    t = _table()
+    t.register("cand", object(), weight=0.2)
+    with pytest.raises(ValueError):
+        t.retire("default")  # live: promote a replacement first
+    t.retire("cand")
+    assert t.get("cand").state == "retired"
+    assert t.get("cand").weight == 0.0
+    with pytest.raises(ValueError):
+        t.promote("cand")  # retired never comes back
+
+
+def test_table_route_mechanisms():
+    t = _table()
+    # single variant: default mechanism, no hashing
+    e, how = t.route("u1")
+    assert (e.variant_id, how) == ("default", "default")
+    t.register("cand", object(), weight=1.0)
+    e, how = t.route("u1")
+    assert how == "hashed"
+    # hashed pick agrees with the pure function
+    expect = bucket_for("u1", t.weights())
+    assert e.variant_id == expect
+    # forced: must exist...
+    with pytest.raises(KeyError):
+        t.route("u1", forced="nope")
+    # ...but MAY be retired (replay re-hits ended experiments)
+    t.retire("cand")
+    e, how = t.route("u1", forced="cand")
+    assert (e.variant_id, how) == ("cand", "forced")
+    # hashed traffic never reaches the retired variant
+    assert all(t.route(k)[0].variant_id == "default"
+               for k in _keys(50))
+
+
+def test_table_snapshot_shares():
+    t = _table()
+    t.register("cand", object(), weight=0.25)
+    t.set_weight("default", 0.75)
+    snap = t.snapshot()
+    assert snap["count"] == 2
+    by = {v["variantId"]: v for v in snap["variants"]}
+    assert by["default"]["trafficShare"] == pytest.approx(0.75)
+    assert by["cand"]["trafficShare"] == pytest.approx(0.25)
+    assert set(by["cand"]["routed"]) == {"hashed", "forced", "default"}
+
+
+# ---------------------------------------------------------------------------
+# pio CLI: variantId is its own engine.json field
+
+
+def test_engine_ids_honors_variant_id(tmp_path):
+    from predictionio_tpu.tools.cli import _engine_ids
+
+    d = tmp_path / "eng"
+    d.mkdir()
+    eid, ver, vid = _engine_ids(d, {"id": "myengine", "version": "2",
+                                    "variantId": "exp-b"})
+    assert (eid, ver, vid) == ("myengine", "2", "exp-b")
+    # default: "default", NOT the engine id (the round-1 bug made two
+    # variants of one engine indistinguishable in metadata)
+    eid, ver, vid = _engine_ids(d, {"id": "myengine"})
+    assert (eid, ver, vid) == ("myengine", "1", "default")
+    eid, _, vid = _engine_ids(d, {})
+    assert eid == "eng" and vid == "default"
+
+
+# ---------------------------------------------------------------------------
+# /reload/delta routes by variant and patches in ISOLATION
+
+
+def _factor_skeleton(rng, vid, table=None):
+    """An EngineServer skeleton over an ALS-style factor model (reuses
+    test_streaming's helpers) carrying just the delta-patch + variant
+    state that handle_reload_delta touches."""
+    from tests.test_streaming import _als, _mini_server
+
+    srv = _mini_server(_als(rng))
+    srv.variant_id = vid
+    srv._draining = False  # `draining` is a read-only property
+    if table is None:
+        table = VariantTable(vid, srv)
+    srv.variants = table
+    return srv
+
+
+def test_delta_routes_by_variant_and_isolates(rng):
+    from predictionio_tpu.workflow.create_server import (
+        SERVER_KEY,
+        handle_reload_delta,
+    )
+    from predictionio_tpu.workflow import variants as V
+    from aiohttp import web
+
+    primary = _factor_skeleton(rng, "default")
+    cand = _factor_skeleton(rng, "cand", table=primary.variants)
+    primary.variants.register("cand", cand, weight=0.2)
+    live_uf_before = primary.deployed.result.models[0].user_factors.copy()
+    live_dep_before = primary.deployed
+
+    def factory():
+        app = web.Application()
+        app[SERVER_KEY] = primary
+        app.router.add_post("/reload/delta", handle_reload_delta)
+        return app
+
+    from tests.helpers import ServerThread
+
+    st = ServerThread(factory)
+    try:
+        vec = [float(x) for x in range(6)]
+        # stamped for the candidate: lands on the CANDIDATE's table;
+        # the publisher's eval-gate hit@k rides along and sticks to
+        # the variant it was measured for (the dashboard A/B view)
+        gate = {"folded": 0.4, "baseline": 0.35, "k": 10}
+        r = requests.post(st.url + "/reload/delta",
+                          json={"users": {"u1": vec}, "variant": "cand",
+                                "gate": gate})
+        assert r.status_code == 200, r.text
+        assert r.json()["variant"] == "cand"
+        assert r.json()["appliedCount"] == 1
+        assert cand.patch_epoch == 1
+        assert cand.last_stream_gate == gate
+        assert primary.last_stream_gate is None
+        # ...and the LIVE bundle is bitwise untouched
+        assert primary.patch_epoch == 0
+        assert primary.deployed is live_dep_before
+        assert np.array_equal(
+            primary.deployed.result.models[0].user_factors, live_uf_before)
+
+        # unstamped: single live variant behavior unchanged
+        r = requests.post(st.url + "/reload/delta",
+                          json={"users": {"u2": vec}})
+        assert r.status_code == 200 and r.json()["variant"] == "default"
+        assert primary.patch_epoch == 1
+
+        # unknown variant: 400 + counted, nothing patched
+        r = requests.post(st.url + "/reload/delta",
+                          json={"users": {"u3": vec}, "variant": "ghost"})
+        assert r.status_code == 400
+        assert "unknown variant" in r.json()["message"]
+        assert V._M_DELTA_REJECTED.value("ghost", "unknown") == 1.0
+
+        # retired variant: 400 + counted — a delta must never silently
+        # land on whatever bundle happens to be live
+        primary.variants.retire("cand")
+        r = requests.post(st.url + "/reload/delta",
+                          json={"users": {"u4": vec}, "variant": "cand"})
+        assert r.status_code == 400
+        assert "retired" in r.json()["message"]
+        assert V._M_DELTA_REJECTED.value("cand", "retired") == 1.0
+        assert cand.patch_epoch == 1  # unchanged
+    finally:
+        st.stop()
+
+
+# ---------------------------------------------------------------------------
+# e2e acceptance: two variants, one process, 80/20
+
+
+def _make_ab_engine(tmp_path, name, offset):
+    """A helloworld variant whose Query carries a ``user`` entity id (so
+    hashed routing has a key) and whose predictions carry a
+    distinguishing offset (so responses prove whose code ran)."""
+    from tests.test_multi_engine import _make_hello_engine
+
+    d = _make_hello_engine(tmp_path, name, offset)
+    src = (d / "engine.py").read_text()
+    src = src.replace('day: str = ""', 'day: str = ""\n    user: str = ""', 1)
+    assert "user: str" in src
+    (d / "engine.py").write_text(src)
+    return d
+
+
+def test_multi_variant_e2e(tmp_path):
+    from predictionio_tpu.storage import Storage
+    from predictionio_tpu.tools.cli import main as pio
+    from predictionio_tpu.workflow import resolve_engine_factory
+    from predictionio_tpu.workflow.create_server import (
+        EngineServer,
+        create_engine_server_app,
+    )
+    from tests.helpers import ServerThread
+    from tests.test_multi_engine import _import_events
+
+    d_a = _make_ab_engine(tmp_path, "varlive", 100.0)
+    d_b = _make_ab_engine(tmp_path, "varcand", 200.0)
+    _import_events("varlive", tmp_path, [10.0, 20.0])  # avg 15 -> 115
+    _import_events("varcand", tmp_path, [30.0, 50.0])  # avg 40 -> 240
+    for d in (d_a, d_b):
+        assert pio(["build", "--engine-dir", str(d)]) == 0
+        assert pio(["train", "--engine-dir", str(d)]) == 0
+
+    meta = Storage.get_metadata()
+    inst_a = meta.engine_instance_get_completed("varlive", "1", "default")[0]
+    eng_a = resolve_engine_factory("engine:engine_factory", engine_dir=d_a)
+    primary = EngineServer(eng_a, inst_a)
+    st = ServerThread(lambda: create_engine_server_app(primary))
+    try:
+        base = st.url
+
+        def temp(user, **kw):
+            r = requests.post(base + "/queries.json",
+                              json={"day": "Mon", "user": user}, **kw)
+            assert r.status_code == 200, r.text
+            return r.json()["temperature"]
+
+        # single-variant: everything serves from the live bundle
+        assert temp("u0") == pytest.approx(115.0)
+
+        # register the challenger THROUGH the management endpoint (the
+        # pio deploy --variant-of path) — same process, shared storage
+        r = requests.post(base + "/variants", json={
+            "variantId": "challenger", "engineDir": str(d_b),
+            "weight": 0.2})
+        assert r.status_code == 200, r.text
+        assert r.json()["state"] == "candidate"
+        # duplicate registration: 409
+        r = requests.post(base + "/variants", json={
+            "variantId": "challenger", "engineDir": str(d_b)})
+        assert r.status_code == 409
+        # unknown engine dir: 4xx, not a crash
+        r = requests.post(base + "/variants", json={
+            "variantId": "ghost", "engineDir": str(tmp_path / "nope")})
+        assert r.status_code in (400, 404)
+
+        # 80/20 via the pio CLI weight command
+        assert pio(["variant", "weight", "default", "0.8",
+                    "--url", base]) == 0
+        assert pio(["variant", "list", "--url", base]) == 0
+
+        # hashed routing: every response matches the pure-function
+        # prediction EXACTLY (same hash, same weights)
+        weights = {"default": 0.8, "challenger": 0.2}
+        expect_temp = {"default": 115.0, "challenger": 240.0}
+        hits = {"default": 0, "challenger": 0}
+        for i in range(120):
+            user = f"ab{i}"
+            want = bucket_for(user, weights)
+            assert temp(user) == pytest.approx(expect_temp[want])
+            hits[want] += 1
+        assert hits["default"] > hits["challenger"] > 0
+
+        # forced routing overrides the hash; unknown forced name 400s
+        assert requests.post(
+            base + "/queries.json", json={"day": "Mon", "user": "ab0"},
+            headers={"X-PIO-Variant": "challenger"},
+        ).json()["temperature"] == pytest.approx(240.0)
+        r = requests.post(base + "/queries.json",
+                          json={"day": "Mon", "user": "ab0"},
+                          headers={"X-PIO-Variant": "ghost"})
+        assert r.status_code == 400
+
+        # delta patch to the candidate never alters live responses:
+        # snapshot live answers, patch, compare bitwise
+        probe = [f"ab{i}" for i in range(120)
+                 if bucket_for(f"ab{i}", weights) == "default"][:10]
+        before = [requests.post(base + "/queries.json",
+                                json={"day": "Mon", "user": u}).content
+                  for u in probe]
+        r = requests.post(base + "/reload/delta",
+                          json={"users": {"s1": [0.5] * 4},
+                                "variant": "challenger"})
+        assert r.status_code == 200 and r.json()["variant"] == "challenger"
+        after = [requests.post(base + "/queries.json",
+                               json={"day": "Mon", "user": u}).content
+                 for u in probe]
+        assert before == after
+
+        # per-variant admission: a rate-limited third variant sheds
+        # ALONE while the live variant keeps serving. Registered via
+        # the real CLI path: `pio deploy --variant-of <port>` posts the
+        # recipe to the running server instead of binding a new one.
+        assert pio(["deploy", "--engine-dir", str(d_b),
+                    "--variant-of", str(st.port),
+                    "--variant-id", "shedme", "--weight", "0.0",
+                    "--admission", "--rate-limit-qps", "0.001",
+                    "--rate-limit-burst", "1.0"]) == 0
+        codes = [requests.post(
+            base + "/queries.json", json={"day": "Mon", "user": "x"},
+            headers={"X-PIO-Variant": "shedme"}).status_code
+            for _ in range(5)]
+        assert 200 in codes and 429 in codes  # burst passes, rest shed
+        assert temp("u0") == pytest.approx(115.0)  # live unaffected
+        from predictionio_tpu.workflow import variants as V
+
+        assert V._M_VQUERIES.value("shedme", "shed") > 0
+        assert V._M_VQUERIES.value("default", "shed") == 0.0
+
+        # stats/health carry per-variant blocks
+        stats = requests.get(base + "/stats.json").json()
+        vb = stats["variants"]
+        assert vb["count"] == 3
+        assert set(vb["byVariant"]) == {"default", "challenger", "shedme"}
+        assert vb["byVariant"]["challenger"]["patches"]["epoch"] >= 0
+        health = requests.get(base + "/health.json").json()
+        assert health["variant"] == "default"
+        assert set(health["variants"]) == {"default", "challenger",
+                                           "shedme"}
+        split = requests.get(base + "/variants.json").json()
+        by = {v["variantId"]: v for v in split["variants"]}
+        assert by["default"]["trafficShare"] == pytest.approx(0.8)
+        assert by["challenger"]["trafficShare"] == pytest.approx(0.2)
+
+        # promote under concurrent load: no request drops
+        stop = threading.Event()
+        failures = []
+
+        def hammer(tid):
+            i = 0
+            while not stop.is_set():
+                r = requests.post(base + "/queries.json",
+                                  json={"day": "Mon",
+                                        "user": f"h{tid}-{i}"})
+                if r.status_code != 200:
+                    failures.append((tid, i, r.status_code))
+                i += 1
+
+        threads = [threading.Thread(target=hammer, args=(t,))
+                   for t in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            assert pio(["variant", "promote", "challenger",
+                        "--url", base]) == 0
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+        assert not failures, failures[:5]
+
+        # traffic flipped: challenger is live at the old live weight
+        split = requests.get(base + "/variants.json").json()
+        by = {v["variantId"]: v for v in split["variants"]}
+        assert by["challenger"]["state"] == "live"
+        assert by["challenger"]["weight"] == pytest.approx(0.8)
+        assert by["default"]["state"] == "candidate"
+
+        # retire the old champion: hashed traffic all goes challenger,
+        # forced routing still reaches the retired bundle (replay),
+        # stamped deltas for it are refused
+        assert pio(["variant", "retire", "default", "--url", base]) == 0
+        assert pio(["variant", "retire", "shedme", "--url", base]) == 0
+        for i in range(10):
+            assert temp(f"post{i}") == pytest.approx(240.0)
+        assert requests.post(
+            base + "/queries.json", json={"day": "Mon", "user": "z"},
+            headers={"X-PIO-Variant": "default"},
+        ).json()["temperature"] == pytest.approx(115.0)
+        r = requests.post(base + "/reload/delta",
+                          json={"users": {"s1": [0.5] * 4},
+                                "variant": "default"})
+        assert r.status_code == 400 and "retired" in r.json()["message"]
+
+        # provenance header + body name the routed variant
+        r = requests.post(base + "/queries.json",
+                          json={"day": "Mon", "user": "z2"})
+        assert r.status_code == 200
+        prov = requests.get(base + "/stats.json").json()["provenance"]
+        assert prov["variantId"] == "default"
+    finally:
+        st.stop()
