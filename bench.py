@@ -859,6 +859,81 @@ for shape, model_sharded in (((8, 1), False), ((4, 2), True)):
     return res
 
 
+def tuning_grid_bench() -> dict:
+    """ISSUE 15 acceptance: a 20-trial hyperparameter grid (2 ranks x 10
+    λ values) trained as ONE packed program (models/als.py
+    train_als_grid — shared layout/upload, per-rank-group vmap over the
+    λ lanes, one dispatch per iteration) vs the serial per-trial
+    train_als loop `pio eval` would run. Both legs are END-TO-END from
+    the same host ratings — layout build, device upload and compile
+    included, each leg cold — because that is exactly what a `pio tune`
+    sweep pays. Hard gate: packed must be >= 3x faster; anything less
+    means the grid re-traced per lane and the tentpole regressed. Runs
+    on the 8-device virtual CPU mesh; bitwise per-trial parity is the
+    grid's contract (pinned by tests/test_tuning.py) and spot-checked
+    here so a fast-but-wrong grid can't pass."""
+    code = _VMESH_PREAMBLE + r"""
+from predictionio_tpu.models.als import ALSConfig, train_als, train_als_grid
+from predictionio_tpu.parallel.mesh import make_mesh
+from predictionio_tpu.storage.frame import Ratings
+
+rng = np.random.default_rng(3)
+nu, ni, n = 2_000, 800, 40_000
+users = [f"u{i}" for i in rng.integers(0, nu, n)]
+items = [f"i{i}" for i in rng.integers(0, ni, n)]
+vals = (rng.random(n) * 4 + 1).astype(np.float32)
+ratings = Ratings.from_triples(users, items, vals)
+mesh = make_mesh()
+lams = (0.005, 0.01, 0.02, 0.03, 0.05, 0.08, 0.1, 0.2, 0.3, 0.5)
+configs = [ALSConfig(rank=r, lambda_=l, iterations=3)
+           for r in (8, 16) for l in lams]
+
+t0 = time.time()
+packed = train_als_grid(ratings, configs, mesh)
+grid_s = time.time() - t0
+
+t0 = time.time()
+serial = [train_als(ratings, c, mesh) for c in configs]
+serial_s = time.time() - t0
+
+bad = sum(not np.array_equal(a.user_factors, b.user_factors)
+          or not np.array_equal(a.item_factors, b.item_factors)
+          for a, b in zip(packed, serial))
+print(f"TUNE trials {len(configs)} {bad}")
+print(f"TUNE grid {grid_s:.3f}")
+print(f"TUNE serial {serial_s:.3f}")
+"""
+    res = {}
+    trials = mismatched = None
+    for row in _run_tagged_child(code, "TUNE", 900):
+        if row[0] == "trials":
+            trials, mismatched = int(row[1]), int(row[2])
+        elif row[0] == "grid":
+            res["tune_grid_s"] = float(row[1])
+        elif row[0] == "serial":
+            res["tune_serial_s"] = float(row[1])
+    if len(res) != 2 or trials is None:
+        raise RuntimeError(f"tuning bench incomplete: {res}")
+    if trials < 16:
+        raise RuntimeError(f"tuning bench grid too small: {trials} < 16")
+    if mismatched:
+        raise RuntimeError(
+            f"tuning bench parity violation: {mismatched}/{trials} trials "
+            "differ from their serially-trained twins")
+    res["tune_grid_trials"] = trials
+    speedup = res["tune_serial_s"] / res["tune_grid_s"]
+    res["tune_grid_speedup_x"] = round(speedup, 2)
+    log(f"tuning grid (virtual CPU mesh): {trials} trials packed "
+        f"{res['tune_grid_s']:.1f}s vs serial "
+        f"{res['tune_serial_s']:.1f}s -> {speedup:.1f}x, "
+        f"per-trial factors bitwise-equal")
+    if speedup < 3.0:
+        raise RuntimeError(
+            f"tuning grid speedup {speedup:.2f}x < 3x gate: the packed "
+            "sweep no longer amortizes layout/compile across trials")
+    return res
+
+
 def sharded_retrieval_bench() -> dict:
     """VERDICT r4 item 3 / r5 inversion closure: the model-sharded
     serving path's perf rows, now a 1/2/4/8-way SWEEP through
@@ -2114,6 +2189,7 @@ def main() -> None:
     # vs_baseline (the wedge hit before the cpu floor ever ran).
     sections: list = [
         ("factor sharding", factor_sharding_bench, 2400, False),
+        ("tuning grid", tuning_grid_bench, 900, False),
         ("sharded retrieval", sharded_retrieval_bench, 900, False),
         ("ann retrieval", ann_retrieval_bench, 900, False),
         ("event ingest", event_ingest_throughput, 900, False),

@@ -113,18 +113,46 @@ class FastEvalEngine(Engine):
             self.hit_counts["preparator"] += 1
         return self._prep_cache[k]
 
+    def _algo_key(self, ep: EngineParams, pair) -> str:
+        return _key(ep.data_source_params, ep.preparator_params, pair)
+
     def _models(self, ctx, ep: EngineParams, prepared: list) -> list:
-        k = _key(ep.data_source_params, ep.preparator_params,
-                 *ep.algorithm_params_list)
-        if k not in self._algo_cache:
-            _names, algos = self.make_algorithms(ep)
-            self._algo_cache[k] = [
-                [algo.train(ctx, pd) for algo in algos]
-                for pd, _ei, _qa in prepared
-            ]
-        else:
+        # cache per INDIVIDUAL algorithm pair, not per whole list
+        # (reference FastEvalEngine.scala:176-206 keys AlgorithmsPrefix
+        # per algo too): two variants sharing one algo config re-train
+        # only the configs that differ, and ``seed_models`` can inject a
+        # grid's pre-trained trials one algo at a time.
+        # hit_counts["algorithms"] still counts whole-variant hits (every
+        # algo served from cache) — the granularity tests pin.
+        _names, algos = self.make_algorithms(ep)
+        pairs = list(ep.algorithm_params_list)
+        per_algo: list[list] = []
+        all_hit = bool(pairs)
+        for pair, algo in zip(pairs, algos):
+            k = self._algo_key(ep, pair)
+            if k not in self._algo_cache:
+                all_hit = False
+                self._algo_cache[k] = [
+                    algo.train(ctx, pd) for pd, _ei, _qa in prepared
+                ]
+            per_algo.append(self._algo_cache[k])
+        if all_hit:
             self.hit_counts["algorithms"] += 1
-        return self._algo_cache[k]
+        n_folds = len(prepared)
+        return [[m[f] for m in per_algo] for f in range(n_folds)]
+
+    def seed_models(self, ep: EngineParams, per_fold_models: list) -> None:
+        """Inject pre-trained models for ``ep`` into the per-algorithm
+        cache — ``per_fold_models[fold][algo]`` order, matching what
+        ``_models`` returns. The tuning grid uses this: ``train_als_grid``
+        trains every trial's folds in one compiled program, seeds them
+        here, and the subsequent ``eval(ctx, ep)`` scores straight from
+        cache without retraining."""
+        pairs = list(ep.algorithm_params_list)
+        for a_idx, pair in enumerate(pairs):
+            self._algo_cache[self._algo_key(ep, pair)] = [
+                fold[a_idx] for fold in per_fold_models
+            ]
 
     def eval(self, ctx, engine_params: EngineParams) -> list[EvalFold]:
         # same policy as Engine.eval: no mid-training checkpoints for the

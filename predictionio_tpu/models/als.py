@@ -61,8 +61,15 @@ _M_TRAIN_STEP = METRICS.histogram(
     "pio_train_step_seconds",
     "one ALS alternation (user+item half-steps); async dispatch means a "
     "step observes the previous step's device time")
+# ISSUE 15: one vmapped grid alternation — EVERY trial's user+item
+# half-steps in a single compiled dispatch (workflow/tuning.py divides by
+# the trial count for a per-trial figure)
+_M_GRID_STEP = METRICS.histogram(
+    "pio_tune_grid_step_seconds",
+    "one multi-trial ALS grid alternation: all trials' user+item "
+    "half-steps in one compiled program (train_als_grid)")
 
-__all__ = ["ALSModel", "ALSConfig", "train_als"]
+__all__ = ["ALSModel", "ALSConfig", "train_als", "train_als_grid"]
 
 #: single source of truth for the CG inner-solver depth — ALSConfig, the
 #: bench, and direct make_train_step/_half_step callers must agree, or an
@@ -1252,3 +1259,228 @@ def train_als(ratings: Ratings, config: ALSConfig, mesh=None, *,
         item_ids=ratings.item_ids,
         config=config,
     )
+
+
+#: ALSConfig fields a grid must share — everything that shapes the layout,
+#: the compiled program, or the init. Only rank/lambda_/alpha may vary
+#: (rank via per-rank program groups; λ/α as vmapped trial-lane inputs).
+_GRID_SHARED_FIELDS = ("iterations", "implicit_prefs", "tiers",
+                       "gather_budget", "chunk_cap", "compute_dtype",
+                       "solver", "cg_iters", "seed")
+
+
+def train_als_grid(ratings: Ratings, configs, mesh=None, *,
+                   observe=None) -> "list[ALSModel]":
+    """Train a whole hyperparameter grid as ONE compiled program (ISSUE 15).
+
+    The ALX lesson (arXiv:2112.02194) is that TPU ALS wins by keeping the
+    chips saturated; a rank/λ/α sweep of dozens of SMALL independent
+    trains is the many-small-problems version of that workload. Instead
+    of a serial per-trial loop (one under-utilizing program per config,
+    each re-paying layout + device upload + compile), this stacks the
+    trials along a leading ``trial`` lane axis and runs every trial's
+    user+item half-steps in a single jitted dispatch per iteration:
+
+    - the permuted two-sided layout and neighbor buckets depend only on
+      the DATA and the shared seed — built once, uploaded once
+      (``put_layout`` block-row sharding over every mesh axis, exactly as
+      the serial path), and closed over by every trial;
+    - trials GROUP BY RANK (rank is a static shape); within a group the
+      λ/α lanes ride ``jax.vmap`` over ``_solve_side`` — λ and α enter
+      the math as traced per-lane scalars (the ridge shift and the
+      implicit confidence scale), so one compiled program serves every
+      lane. All rank groups' sweeps live in the SAME jitted step, so the
+      whole grid is one dispatch per iteration;
+    - per-lane init replicates ``train_als``'s exactly (same PRNGKey
+      split, same abs/√rank scheme, same slot permutation — the seed is
+      shared, so every lane of a rank group starts identically), CG warm
+      starts carry per-lane previous factors, and factor buffers are
+      donated across iterations — matching the serial step so per-trial
+      factors come out bitwise-equal to individually-trained runs
+      (pinned by test_tuning.py's parity test).
+
+    ``configs`` may vary only ``rank``/``lambda_``/``alpha``; all other
+    fields (and the seed) must match trial 0, and ``model_sharded`` grids
+    are not supported — the grid IS the parallelism. No checkpointer:
+    grids are short exploratory runs; per-trial failure isolation lives
+    in ``workflow/tuning.py``.
+
+    ``observe(trial_idx, it, loss, delta_norm, step_seconds)`` is called
+    per trial per iteration with the sampled-holdout probe's RMSE/delta
+    (``step_seconds`` is the WHOLE grid step — the caller owns per-trial
+    attribution), feeding ConvergenceTracker ``tune:<trial>`` series.
+    Returns one ``ALSModel`` per config, in input order.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    configs = list(configs)
+    if not configs:
+        raise ValueError("empty config grid")
+    base = configs[0]
+    for i, c in enumerate(configs):
+        if c.model_sharded:
+            raise ValueError(
+                f"trial {i}: model_sharded is not supported in a grid "
+                "(the trial axis is the parallelism)")
+        for f in _GRID_SHARED_FIELDS:
+            if getattr(c, f) != getattr(base, f):
+                raise ValueError(
+                    f"trial {i}: {f}={getattr(c, f)!r} differs from trial "
+                    f"0's {getattr(base, f)!r}; a grid may vary only "
+                    "rank/lambda_/alpha")
+    if base.iterations < 1:
+        raise ValueError("grid training needs iterations >= 1")
+
+    if mesh is None:
+        from ..parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+
+    nu, ni = ratings.num_users, ratings.num_items
+    if nu == 0 or ni == 0:
+        raise ValueError("empty ratings: no users or items")
+
+    # layout + buckets: identical to the serial path (they depend only on
+    # data + seed, never on rank/λ/α) — built and uploaded ONCE for the
+    # whole grid
+    u_lay, i_lay = build_bilinear_layout(
+        ratings.user_indices, ratings.item_indices, ratings.ratings, nu, ni,
+        tiers=base.tiers, gather_budget=base.gather_budget,
+        seed=base.seed, chunk_cap=base.chunk_cap, align=8,
+    )
+    dropped = u_lay.dropped + i_lay.dropped
+    if dropped:
+        log.info("degree tiers dropped %d entries beyond the last tier", dropped)
+    vals_dtype = "bfloat16" if base.compute_dtype == "bfloat16" else None
+    u_bk = put_layout(u_lay, mesh, vals_dtype=vals_dtype)
+    i_bk = put_layout(i_lay, mesh, vals_dtype=vals_dtype)
+
+    # rank groups in first-occurrence order, remembering each trial's
+    # original index so results come back in input order
+    by_rank: dict[int, list[int]] = {}
+    for idx, c in enumerate(configs):
+        by_rank.setdefault(c.rank, []).append(idx)
+    groups = list(by_rank.items())  # [(rank, [trial_idx, ...]), ...]
+
+    # init: the EXACT serial scheme — one PRNGKey split per grid (seed is
+    # shared), per-rank normal draws, abs/√rank, permuted into slot order
+    # with padding slots exactly zero — then stacked per lane (identical
+    # lanes: the serial run at the same seed starts from the same init)
+    key = jax.random.PRNGKey(base.seed)
+    k_u, k_v = jax.random.split(key)
+
+    def _perm_init(k, n_rows, lay, rank):
+        host = (np.abs(np.asarray(jax.random.normal(
+            k, (n_rows, rank), dtype=jnp.float32))) / np.sqrt(rank))
+        perm = np.zeros((lay.slots, rank), np.float32)
+        perm[lay.pos] = host
+        return perm
+
+    rep3 = NamedSharding(mesh, P(None, None, None))
+    facs = []
+    hypers = []
+    for rank_g, idxs in groups:
+        lanes = len(idxs)
+        v0 = _perm_init(k_v, ni, i_lay, rank_g)
+        u0 = _perm_init(k_u, nu, u_lay, rank_g)
+        facs.append((
+            jax.device_put(np.stack([u0] * lanes), rep3),
+            jax.device_put(np.stack([v0] * lanes), rep3),
+        ))
+        hypers.append((
+            jnp.asarray([configs[i].lambda_ for i in idxs], jnp.float32),
+            jnp.asarray([configs[i].alpha for i in idxs], jnp.float32),
+        ))
+    facs, hypers = tuple(facs), tuple(hypers)
+
+    # CG depth: replicate train_als's cold-depth override (short runs
+    # never benefit from the warm shortcut), then make_train_step's
+    # warm-aware resolution — the grid and the serial trial must compile
+    # the same inner-solver depth or parity dies
+    implicit = bool(base.implicit_prefs)
+    warm = base.solver == "cg"
+    cg_iters = base.cg_iters
+    if (cg_iters is None and base.solver == "cg"
+            and not implicit and base.iterations < 3):
+        cg_iters = DEFAULT_CG_ITERS
+    cg_resolved = _resolve_cg_iters(cg_iters, implicit, warm=warm)
+
+    def grid_step(u_buckets, i_buckets, facs, hypers):
+        out = []
+        for (rank_g, _idxs), (u_prev, v), (lam, alp) in zip(
+                groups, facs, hypers):
+
+            def one(u_p, v_p, lam_t, alp_t, rank_g=rank_g):
+                # the serial step body verbatim (make_train_step.step,
+                # model_sharded=False) with λ/α as traced lane scalars
+                kw = dict(lambda_=lam_t, implicit=implicit, alpha=alp_t,
+                          rank=rank_g, compute_dtype=base.compute_dtype,
+                          solver=base.solver, cg_iters=cg_resolved)
+                u_new = _solve_side(u_buckets, u_lay, v_p, kw=kw,
+                                    x0=u_p if warm else None)
+                v_new = _solve_side(i_buckets, i_lay, u_new, kw=kw,
+                                    x0=v_p if warm else None)
+                return u_new, v_new
+
+            out.append(jax.vmap(one)(u_prev, v, lam, alp))
+        return tuple(out)
+
+    step = jax.jit(
+        grid_step,
+        out_shardings=tuple((rep3, rep3) for _ in groups),
+        donate_argnums=(2,))
+
+    probe = (_ConvergenceSampler(ratings, base, u_lay, i_lay)
+             if observe is not None else None)
+    prev_uu: dict[int, np.ndarray] = {}
+    n_trials = len(configs)
+    log.info("ALS grid: %d trial(s) in %d rank group(s) %s, %d iters",
+             n_trials, len(groups), [r for r, _ in groups], base.iterations)
+    for it in range(base.iterations):
+        t_step = time.perf_counter()
+        facs = step(u_bk, i_bk, facs, hypers)
+        step_s = time.perf_counter() - t_step
+        _M_GRID_STEP.record(step_s)
+        if observe is not None:
+            for (_rank_g, idxs), (u_g, v_g) in zip(groups, facs):
+                ug = vg = None
+                if probe.ok:
+                    try:
+                        ug = np.asarray(u_g)[:, probe.u_slots, :]
+                        vg = np.asarray(v_g)[:, probe.i_slots, :]
+                    except Exception:
+                        ug = vg = None
+                for lane, idx in enumerate(idxs):
+                    loss = delta = None
+                    if ug is not None:
+                        try:
+                            uu, vv = ug[lane], vg[lane]
+                            pred = (uu * vv).sum(axis=1)
+                            loss = float(np.sqrt(np.mean(
+                                (pred - probe.r) ** 2)))
+                            p = prev_uu.get(idx)
+                            if p is not None:
+                                delta = float(
+                                    np.linalg.norm(uu - p)
+                                    / (np.linalg.norm(p) + 1e-12))
+                            prev_uu[idx] = uu
+                        except Exception:
+                            loss = delta = None
+                    observe(idx, it, loss, delta, step_s)
+    jax.block_until_ready(facs)
+
+    models: list[ALSModel | None] = [None] * n_trials
+    for (_rank_g, idxs), (u_g, v_g) in zip(groups, facs):
+        uh = _host_global(u_g)
+        vh = _host_global(v_g)
+        for lane, idx in enumerate(idxs):
+            models[idx] = ALSModel(
+                user_factors=uh[lane][u_lay.pos],
+                item_factors=vh[lane][i_lay.pos],
+                user_ids=ratings.user_ids,
+                item_ids=ratings.item_ids,
+                config=configs[idx],
+            )
+    return models

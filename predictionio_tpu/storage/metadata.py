@@ -117,6 +117,12 @@ class EngineInstance:
     #: ``supervisor.check_peer_liveness`` raises ``HostLostError`` when a
     #: peer's goes stale. Empty for single-host / pre-elastic records.
     host_heartbeats: str = ""
+    #: JSON tuning leaderboard (workflow/tuning.py TuneResult
+    #: .leaderboard_json: per-trial params/score/status/error plus the
+    #: winning trial index and metric header) stamped onto the WINNER's
+    #: instance by ``run_tune`` — `pio status` and the dashboard's
+    #: /tune.json read it. Empty for non-tuned runs.
+    tuning: str = ""
 
 
 @dataclass(frozen=True)

@@ -387,11 +387,10 @@ def _parse_mesh(spec: str) -> tuple[int, ...]:
 
 def cmd_eval(args) -> int:
     _enable_compile_cache()
-    from ..workflow import Context, resolve_attr, run_evaluation
+    from ..workflow import Context, run_evaluation
 
     engine_dir = Path(args.engine_dir)
-    ev_obj = resolve_attr(args.evaluation, engine_dir=engine_dir)
-    evaluation = ev_obj() if isinstance(ev_obj, type) else ev_obj
+    evaluation, grid = _resolve_eval_grid(args, engine_dir)
     if args.fast:
         # rebuild the evaluation's engine as a FastEvalEngine: identical
         # components, but pipeline prefixes (datasource folds, prepared
@@ -406,13 +405,6 @@ def cmd_eval(args) -> int:
             evaluation.engine = FastEvalEngine.wrap(evaluation.engine)
         except ValueError as e:
             _die(str(e))
-    if args.engine_params_generator:
-        gen_obj = resolve_attr(args.engine_params_generator,
-                               engine_dir=engine_dir)
-        generator = gen_obj() if isinstance(gen_obj, type) else gen_obj
-        grid = list(generator.engine_params_list)
-    else:
-        grid = list(getattr(evaluation, "engine_params_list", ()))
     if not grid:
         _die("no EngineParams to evaluate (give an EngineParamsGenerator)")
     iid, result = run_evaluation(
@@ -429,6 +421,88 @@ def cmd_eval(args) -> int:
         hits = dict(evaluation.engine.hit_counts)
         _ok(f"FastEval prefix cache hits: {hits or 'none'}")
     _ok(f"Evaluation completed. Instance: {iid}; best params -> best.json")
+    return 0
+
+
+def _resolve_eval_grid(args, engine_dir):
+    """Shared eval/tune preamble: resolve the Evaluation (engine +
+    metrics) and the EngineParams grid (an explicit generator wins over
+    the evaluation's own list)."""
+    from ..workflow import resolve_attr
+
+    ev_obj = resolve_attr(args.evaluation, engine_dir=engine_dir)
+    evaluation = ev_obj() if isinstance(ev_obj, type) else ev_obj
+    if args.engine_params_generator:
+        gen_obj = resolve_attr(args.engine_params_generator,
+                               engine_dir=engine_dir)
+        generator = gen_obj() if isinstance(gen_obj, type) else gen_obj
+        grid = list(generator.engine_params_list)
+    else:
+        grid = list(getattr(evaluation, "engine_params_list", ()))
+    return evaluation, grid
+
+
+def cmd_tune(args) -> int:
+    """`pio tune` (ISSUE 15): train the WHOLE EngineParams grid as one
+    mesh-packed program (models/als.train_als_grid: per-rank vmapped
+    λ/α lanes, one compiled dispatch per iteration), rank the trials,
+    train the winner on the full data, stamp the leaderboard onto its
+    EngineInstance, and — with --deploy — serve it behind the eval
+    gate. Where `pio eval` only REPORTS the best params, tune closes
+    the loop through deployment."""
+    _enable_compile_cache()
+    from ..workflow import Context, run_tune
+
+    engine_dir = Path(args.engine_dir)
+    evaluation, grid = _resolve_eval_grid(args, engine_dir)
+    if not grid:
+        _die("no EngineParams to tune (give an EngineParamsGenerator)")
+    metrics = evaluation.all_metrics
+    variant = _load_variant(engine_dir, args.engine_json)
+    engine_id, version, variant_id = _engine_ids(engine_dir, variant)
+    iid, tune, gate = run_tune(
+        evaluation.engine,
+        grid,
+        metrics[0],
+        metrics[1:],
+        Context(mode="Evaluation", batch=args.batch),
+        engine_id=engine_id,
+        engine_version=version,
+        engine_variant=variant_id,
+        engine_factory=variant.get("engineFactory", ""),
+        batch=args.batch,
+        evaluator_class=args.evaluation,
+        max_retries=args.max_retries,
+        retry_backoff_s=args.retry_backoff_s,
+        eval_gate=args.eval_gate,
+        best_json_path=str(engine_dir / "best.json"),
+        train_max_retries=args.train_max_retries,
+        train_budget_s=args.train_budget_s or None,
+    )
+    _ok(tune.pretty_print())
+    _ok(f"packed grid: {tune.grid_mode} "
+        f"({len(tune.trials)} trial(s), {tune.grid_seconds:.2f}s)")
+    _ok(f"Winner trial #{tune.winner.index} trained as instance {iid}; "
+        "best params -> best.json")
+    _ok(f"gate: {gate['decision']} (candidate={gate['candidate']}, "
+        f"baseline={gate['baseline']}, threshold={gate['threshold']})")
+    if not args.deploy:
+        return 0
+    if gate["decision"] == "hold":
+        _ok("eval gate HELD deployment — the incumbent keeps serving. "
+            "Deploy anyway with `pio deploy --engine-instance-id "
+            f"{iid}`.")
+        return 2
+    from ..workflow.create_server import run_engine_server
+
+    inst = _storage().get_metadata().engine_instance_get(iid)
+    engine = _engine_from_variant(engine_dir, variant)
+    run_engine_server(
+        engine, inst,
+        # the gate already vouched for THIS instance; never fall back
+        # to an older one
+        fallback=False,
+        ip=args.ip, port=args.port, engine_dir=engine_dir)
     return 0
 
 
@@ -1153,6 +1227,35 @@ def cmd_status(args) -> int:
                     f"{f'{loss:.4f}' if loss is not None else 'n/a'}, "
                     f"mean step "
                     f"{f'{step * 1e3:.1f}ms' if step is not None else 'n/a'}")
+            # ISSUE 15: stamped eval result + tuning leaderboard
+            if getattr(inst, "evaluator_results", ""):
+                _ok(f"    eval: {inst.evaluator_results}")
+            try:
+                tdoc = (json.loads(inst.tuning)
+                        if getattr(inst, "tuning", "") else None)
+            except ValueError:
+                tdoc = None
+            if tdoc:
+                rows = tdoc.get("trials", [])
+                done_rows = sorted(
+                    (r for r in rows if r.get("status") == "COMPLETED"),
+                    key=lambda r: (r.get("score") is not None,
+                                   r.get("score")),
+                    reverse=not tdoc.get("lowerIsBetter"))
+                _ok(f"    tuning: {len(rows)} trial(s), "
+                    f"{tdoc.get('gridMode')} grid "
+                    f"({tdoc.get('gridSeconds')}s), "
+                    f"metric {tdoc.get('metricHeader')}")
+                for r in done_rows[:3]:
+                    star = ("  <== winner"
+                            if r.get("trial") == tdoc.get("bestTrial")
+                            else "")
+                    _ok(f"      trial #{r.get('trial')}: "
+                        f"{r.get('score')}{star}")
+                for r in rows:
+                    if r.get("status") != "COMPLETED":
+                        _ok(f"      trial #{r.get('trial')} FAILED: "
+                            f"{r.get('error')}")
     except Exception as e:  # noqa: BLE001
         _ok(f"  completed runs: unavailable ({e})")
     try:
@@ -1437,6 +1540,47 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--fast", action="store_true",
                     help="memoize pipeline prefixes across grid variants "
                          "(FastEvalEngine)")
+
+    sp = sub.add_parser(
+        "tune",
+        help="mesh-packed hyperparameter sweep: train the WHOLE "
+             "EngineParams grid as one compiled program, rank the "
+             "trials, train the winner, and optionally deploy it behind "
+             "an eval gate")
+    _add_engine_args(sp)
+    sp.add_argument("evaluation", help="module:EvaluationClass "
+                                       "(engine + metrics)")
+    sp.add_argument("engine_params_generator", nargs="?",
+                    help="module:EngineParamsGenerator (default: the "
+                         "evaluation's engine_params_list)")
+    sp.add_argument("--batch", default="")
+    sp.add_argument("--max-retries", type=int, default=0,
+                    help="per-trial retries for transient scoring "
+                         "failures; a trial that still fails becomes a "
+                         "FAILED leaderboard row, never kills the sweep "
+                         "(default 0)")
+    sp.add_argument("--retry-backoff-s", type=float, default=0.25,
+                    help="base of the per-trial jittered retry backoff "
+                         "(default 0.25)")
+    sp.add_argument("--train-max-retries", type=int, default=2,
+                    help="supervised retries for the WINNER's full "
+                         "training run (default 2)")
+    sp.add_argument("--train-budget-s", type=float, default=0.0,
+                    help="wall-clock budget for the winner's training "
+                         "run (0 = unlimited)")
+    sp.add_argument("--eval-gate", type=float, default=None,
+                    metavar="DELTA",
+                    help="promotion gate: deploy only if the winner's "
+                         "score does not regress more than DELTA vs the "
+                         "incumbent instance's stamped score (flipped "
+                         "for lower-is-better metrics; default: "
+                         "ungated)")
+    sp.add_argument("--deploy", action="store_true",
+                    help="after tuning, serve the winner's instance "
+                         "(honors --eval-gate: a held gate exits 2 "
+                         "without deploying)")
+    sp.add_argument("--ip", default="0.0.0.0")
+    sp.add_argument("--port", type=int, default=8000)
 
     sp = sub.add_parser("deploy")
     _add_engine_args(sp)
@@ -1853,6 +1997,7 @@ COMMANDS = {
     "unregister": cmd_unregister,
     "train": cmd_train,
     "eval": cmd_eval,
+    "tune": cmd_tune,
     "deploy": cmd_deploy,
     "batchpredict": cmd_batchpredict,
     "bench": cmd_bench,

@@ -109,6 +109,46 @@ async def handle_variants(request: web.Request) -> web.Response:
     })
 
 
+async def handle_tune(request: web.Request) -> web.Response:
+    """ISSUE 15: the latest tuning leaderboard. Unlike /slo.json this
+    reads metadata directly — the leaderboard is stamped onto the
+    winner's EngineInstance (``.tuning``) by ``run_tune``, so it needs
+    no live engine server. ``?instance=<id>`` pins a specific instance;
+    the default is the newest COMPLETED instance that carries one."""
+    import json as json_mod
+
+    meta = Storage.get_metadata()
+    iid = request.query.get("instance")
+    if iid:
+        inst = meta.engine_instance_get(iid)
+        if inst is None or not getattr(inst, "tuning", ""):
+            return web.json_response(
+                {"message": f"no tuning leaderboard on instance {iid!r}"},
+                status=404)
+    else:
+        inst = next(
+            (i for i in meta.engine_instance_get_by_status("COMPLETED")
+             if getattr(i, "tuning", "")), None)
+        if inst is None:
+            return web.json_response(
+                {"message": "no COMPLETED instance carries a tuning "
+                            "leaderboard; run `pio tune` first"},
+                status=404)
+    try:
+        doc = json_mod.loads(inst.tuning)
+    except ValueError:
+        return web.json_response(
+            {"message": f"instance {inst.id!r} has an unparseable "
+                        "tuning document"}, status=500)
+    return web.json_response({
+        "engineInstanceId": inst.id,
+        "engineId": inst.engine_id,
+        "engineVariant": inst.engine_variant,
+        "evaluatorResults": inst.evaluator_results,
+        "tuning": doc,
+    })
+
+
 @web.middleware
 async def cors_middleware(request: web.Request, handler):
     """(reference CorsSupport.scala — allow-all CORS for dashboard XHR)"""
@@ -151,7 +191,10 @@ async def handle_index(request: web.Request) -> web.Response:
         'the device HBM ledger: <a href="/train.json">/train.json</a>; '
         'A/B traffic split and per-variant serving: '
         '<a href="/variants.json">/variants.json</a> '
-        "(proxied from the engine server's /stats.json)</p></body></html>"
+        "(proxied from the engine server's /stats.json); "
+        'latest `pio tune` leaderboard: '
+        '<a href="/tune.json">/tune.json</a> '
+        "(read from metadata, no engine server needed)</p></body></html>"
     )
     return web.Response(text=body, content_type="text/html")
 
@@ -198,6 +241,7 @@ def create_dashboard_app(
     app.router.add_get("/slo.json", handle_slo)
     app.router.add_get("/train.json", handle_train)
     app.router.add_get("/variants.json", handle_variants)
+    app.router.add_get("/tune.json", handle_tune)
     app.router.add_get(
         "/engine_instances/{instance_id}/evaluator_results.txt", handle_results_txt
     )

@@ -10,6 +10,7 @@ from .core_workflow import (
     resolve_engine_factory,
     run_evaluation,
     run_train,
+    stamp_evaluator_results,
 )
 from .serialization import (
     PersistentModelManifest,
@@ -18,6 +19,7 @@ from .serialization import (
     serialize_models,
 )
 from .streaming import StreamingUpdater
+from .tuning import TrialResult, TuneResult, TuneSupervisor, run_tune
 from .supervisor import (
     TrainBudgetExceeded,
     TrainSupervisor,
@@ -30,10 +32,11 @@ __all__ = [
     "Context", "ModelIntegrityError", "PersistentModelManifest",
     "RetrainMarker", "StreamingUpdater",
     "TrainBudgetExceeded", "TrainCheckpointer",
-    "TrainSupervisor", "TransientTrainingError", "WorkflowParams",
+    "TrainSupervisor", "TransientTrainingError", "TrialResult",
+    "TuneResult", "TuneSupervisor", "WorkflowParams",
     "classify_error",
     "deserialize_models", "engine_params_from_instance", "prepare_deploy",
     "reap_orphans",
     "resolve_attr", "resolve_engine_factory", "run_evaluation", "run_train",
-    "serialize_models",
+    "run_tune", "serialize_models", "stamp_evaluator_results",
 ]

@@ -43,7 +43,7 @@ _SCOPED_ENGINE_DIRS: dict = {}
 
 __all__ = [
     "resolve_attr", "resolve_engine_factory", "run_train", "run_evaluation",
-    "prepare_deploy", "ModelIntegrityError",
+    "stamp_evaluator_results", "prepare_deploy", "ModelIntegrityError",
 ]
 
 
@@ -370,9 +370,16 @@ def run_evaluation(
     generator_class: str = "",
     batch: str = "",
     best_json_path: str | None = None,
+    engine_instance_id: str | None = None,
 ) -> tuple[str, MetricEvaluatorResult]:
     """Batch-eval a params grid and rank it (CoreWorkflow.runEvaluation,
-    CoreWorkflow.scala:96-150 + EvaluationWorkflow.scala:29-41)."""
+    CoreWorkflow.scala:96-150 + EvaluationWorkflow.scala:29-41).
+
+    ``engine_instance_id`` additionally stamps the ranked result onto
+    that EngineInstance record (ISSUE 15 satellite: eval results used to
+    be stdout + EvaluationInstance only, invisible to ``pio status``'s
+    completed-runs view). The stamp re-reads the freshest record so it
+    composes with concurrent heartbeat/status writers."""
     ctx = ctx or Context(mode="Evaluation", batch=batch)
     meta = Storage.get_metadata()
     instance = EvaluationInstance(
@@ -403,6 +410,9 @@ def run_evaluation(
                 evaluator_results_json=result.to_json(),
             )
         )
+        if engine_instance_id:
+            stamp_evaluator_results(engine_instance_id, result,
+                                    evaluator_class=evaluation_class)
         log.info("Evaluation completed: instance %s", instance_id)
         return instance_id, result
     except BaseException:
@@ -411,6 +421,34 @@ def run_evaluation(
             dataclasses.replace(instance, status="ABORTED", end_time=_now())
         )
         raise
+
+
+def stamp_evaluator_results(engine_instance_id: str,
+                            result: MetricEvaluatorResult, *,
+                            evaluator_class: str = "",
+                            tuning_json: str | None = None) -> None:
+    """Stamp a ranked eval result (and optionally a tuning leaderboard)
+    onto an EngineInstance so `pio status` can show WHY this model was
+    chosen. Re-reads the freshest record before replacing fields —
+    heartbeats or a concurrent status flip must not be clobbered. A
+    missing instance is a no-op (the eval itself already succeeded)."""
+    meta = Storage.get_metadata()
+    cur = meta.engine_instance_get(engine_instance_id)
+    if cur is None:
+        log.warning("stamp_evaluator_results: no engine instance %s",
+                    engine_instance_id)
+        return
+    extra: dict[str, Any] = {}
+    if evaluator_class:
+        extra["evaluator_class"] = evaluator_class
+    if tuning_json is not None:
+        extra["tuning"] = tuning_json
+    meta.engine_instance_update(dataclasses.replace(
+        cur,
+        evaluator_results=result.to_one_liner(),
+        evaluator_results_json=result.to_json(),
+        **extra,
+    ))
 
 
 def prepare_deploy(

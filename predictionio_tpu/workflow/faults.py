@@ -86,6 +86,11 @@ Sites instrumented in this repo:
   the engine server (sync site; an ``error`` is an unreachable server —
   feeds the publish breaker, and the follow cursor must NOT advance so
   a restart replays the batch; the exactly-once chaos test arms this)
+- ``tune.trial``             — head of each trial's supervised
+  score-and-record body in ``workflow/tuning.TuneSupervisor`` (sync
+  site; an ``error`` with ``times=1`` fails exactly one trial and the
+  leaderboard must show that trial FAILED while every other trial
+  completes and a winner still promotes)
 
 A fault is armed per site with a kind:
 
@@ -139,6 +144,7 @@ SITES: tuple[str, ...] = (
     "stream.tail",
     "stream.fold_in",
     "stream.publish",
+    "tune.trial",
 )
 
 #: chaos runs must always be measurable: one counter series per site,

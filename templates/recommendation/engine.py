@@ -148,15 +148,22 @@ class ALSAlgorithm(Algorithm):
     params_class = AlgorithmParams
     query_class = Query
 
-    def train(self, ctx, ratings: Ratings) -> ALSModel:
-        cfg = ALSConfig(
+    def als_config(self) -> ALSConfig:
+        """The exact ALSConfig ``train`` uses — the hook `pio tune` keys
+        on to pack a whole params grid into one ``train_als_grid``
+        program (workflow/tuning.py). Must stay in lockstep with
+        ``train``: the packed grid's bitwise parity with serial training
+        holds only if both paths train the same config."""
+        return ALSConfig(
             rank=self.params.rank,
             iterations=self.params.num_iterations,
             lambda_=self.params.lambda_,
             seed=self.params.seed,
         )
+
+    def train(self, ctx, ratings: Ratings) -> ALSModel:
         return train_als(
-            ratings, cfg, mesh=ctx.mesh,
+            ratings, self.als_config(), mesh=ctx.mesh,
             checkpointer=ctx.checkpointer("als"),
             checkpoint_every=ctx.checkpoint_every,
         )

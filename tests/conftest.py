@@ -85,6 +85,14 @@ def pytest_configure(config):
         "its exact-fallback and parity contracts, and the adaptive "
         "shard-count cost model — ops/ann.py, ops/retrieval.py; "
         "test_ann.py); select with -m retrieval")
+    config.addinivalue_line(
+        "markers",
+        "tune: hyperparameter-sweep tests (the mesh-packed train_als_grid "
+        "program and its bitwise-parity contract, TuneSupervisor trial "
+        "isolation, eval-gated winner promotion and the tune.trial chaos "
+        "site — workflow/tuning.py, models/als.py train_als_grid; "
+        "test_tuning.py); shares the chaos guard's SIGALRM timeout and "
+        "fault cleanup; select with -m tune")
 
 
 #: Hard per-test budget for chaos tests. Injected hangs are capped at
@@ -104,7 +112,8 @@ def _chaos_guard(request):
             and request.node.get_closest_marker("train_chaos") is None
             and request.node.get_closest_marker("streaming") is None
             and request.node.get_closest_marker("replay") is None
-            and request.node.get_closest_marker("multiengine") is None):
+            and request.node.get_closest_marker("multiengine") is None
+            and request.node.get_closest_marker("tune") is None):
         yield
         return
 
@@ -143,7 +152,8 @@ def _multihost_guard(request):
             or request.node.get_closest_marker("chaos") is not None
             or request.node.get_closest_marker("train_chaos") is not None
             or request.node.get_closest_marker("streaming") is not None
-            or request.node.get_closest_marker("multiengine") is not None):
+            or request.node.get_closest_marker("multiengine") is not None
+            or request.node.get_closest_marker("tune") is not None):
         yield
         return
 

@@ -178,6 +178,19 @@ def test_pio_train_help_documents_distributed_flags(tmp_path):
         assert flag in out.stdout, f"{flag} missing from train --help"
 
 
+def test_pio_tune_help_documents_sweep_flags(tmp_path):
+    """ISSUE 15: `pio tune --help` must advertise the sweep surface —
+    per-trial retries, the winner's training knobs, and the eval-gated
+    --deploy the Hyperparameter tuning runbook documents."""
+    env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
+    out = subprocess.run([str(REPO / "bin" / "pio"), "tune", "--help"],
+                         capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    for flag in ("--max-retries", "--train-max-retries",
+                 "--train-budget-s", "--eval-gate", "--deploy"):
+        assert flag in out.stdout, f"{flag} missing from tune --help"
+
+
 def test_pio_admin_reap_help_documents_flags(tmp_path):
     env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
     out = subprocess.run([str(REPO / "bin" / "pio"), "admin", "reap",
