@@ -33,7 +33,7 @@ from .metrics import METRICS
 #: histograms are per-kind FAMILIES (the registry's histograms are
 #: unlabeled), pre-registered from this fixed tuple so the doc-catalog
 #: guard sees every concrete name at import time.
-KINDS = ("kernel", "xla", "sharded", "ann", "fold_in", "other")
+KINDS = ("kernel", "xla", "sharded", "ann", "fold_in", "pipeline", "other")
 
 COMPILE_HISTOGRAMS = {
     k: METRICS.histogram(

@@ -354,6 +354,18 @@ class AnnRetriever:
                                                  interpret=self._interpret)
         return self._exact_cached
 
+    @property
+    def lane_dim(self) -> int:
+        """Query lane width the compiled ANN programs lower against
+        (128-rounded feature dim; the programs slice ``q[:, :d]`` back
+        out). Queries pre-padded to this width pass through
+        ``_dispatch_topk``'s lane pad unchanged AND through the exact
+        delegate bitwise-identically — the contract the device-resident
+        pipeline's gather handoff (``ops/pipeline.py``) relies on: a
+        gathered ``[b_pad, lane_dim]`` matrix needs no host re-pad and
+        cannot perturb the delegate-vs-ann fallback numerics."""
+        return ((self.dim + 127) // 128) * 128
+
     # -- compiled ANN program ---------------------------------------------
     def _build_call(self, b_pad: int, k_pad: int, eff: int, *,
                     pin: bool = False):

@@ -84,15 +84,20 @@ class ExecutableCache:
         self._entries: dict = {}  # insertion order = LRU order
         self._pinned: dict = {}   # ordered set of pinned keys
         self._lock = threading.Lock()
+        self._building: dict = {}  # key -> per-key build lock
         self.hits = 0
         self.misses = 0
         self.evictions = 0
 
     def get_or_build(self, key, build):
         """Return the cached value for ``key``, building (and inserting)
-        it on a miss. ``build()`` runs OUTSIDE the lock — compiles take
-        seconds and must not serialize the serving threads; two threads
-        racing the same key may both compile, first insert wins."""
+        it on a miss. ``build()`` runs OUTSIDE the cache lock — compiles
+        take seconds and must not serialize the serving threads — but
+        UNDER a per-key build lock, so two threads missing the same key
+        compile it once: the loser waits and takes the winner's entry
+        as a hit instead of burning a duplicate compile that the ledger
+        would have to discard (ISSUE 16 satellite; the two-thread test
+        pins exactly one pio_xla_compile_* observation)."""
         with self._lock:
             if key in self._entries:
                 self.hits += 1
@@ -100,29 +105,40 @@ class ExecutableCache:
                 self._entries[key] = val  # re-insert at the recent end
                 _M_EXEC_CACHE.inc(event="hit")
                 return val
-            self.misses += 1
-        _M_EXEC_CACHE.inc(event="miss")
-        t0 = time.perf_counter()
-        val = build()
-        # analysis probes outside the lock (they can walk the whole HLO);
-        # residency bookkeeping (admit/discard) inside, in lockstep with
-        # the insert/evict it accounts for — ISSUE 12's HBM ledger
-        entry = LEDGER.analyze(key, val, time.perf_counter() - t0)
-        with self._lock:
-            if key in self._entries:
-                return self._entries[key]  # lost the build race
-            while len(self._entries) >= self.maxsize:
-                victim = next((k for k in self._entries
-                               if k not in self._pinned), None)
-                if victim is None:
-                    break  # everything pinned: admit over budget
-                self._entries.pop(victim)
-                self.evictions += 1
-                _M_EXEC_CACHE.inc(event="evict")
-                LEDGER.discard(victim)
-            self._entries[key] = val
-            LEDGER.admit(entry)
-        return val
+            key_lock = self._building.setdefault(key, threading.Lock())
+        with key_lock:
+            with self._lock:
+                if key in self._entries:
+                    # a racing thread finished this build while we
+                    # waited on the key lock: that's a hit, not a
+                    # second compile
+                    self.hits += 1
+                    val = self._entries.pop(key)
+                    self._entries[key] = val
+                    _M_EXEC_CACHE.inc(event="hit")
+                    return val
+                self.misses += 1
+            _M_EXEC_CACHE.inc(event="miss")
+            t0 = time.perf_counter()
+            val = build()
+            # analysis probes outside the lock (they can walk the whole
+            # HLO); residency bookkeeping (admit/discard) inside, in
+            # lockstep with the insert/evict it accounts for — ISSUE 12
+            entry = LEDGER.analyze(key, val, time.perf_counter() - t0)
+            with self._lock:
+                while len(self._entries) >= self.maxsize:
+                    victim = next((k for k in self._entries
+                                   if k not in self._pinned), None)
+                    if victim is None:
+                        break  # everything pinned: admit over budget
+                    self._entries.pop(victim)
+                    self.evictions += 1
+                    _M_EXEC_CACHE.inc(event="evict")
+                    LEDGER.discard(victim)
+                self._entries[key] = val
+                LEDGER.admit(entry)
+                self._building.pop(key, None)
+            return val
 
     def pin(self, key) -> None:
         """Exempt ``key`` from eviction (hot serving shapes)."""
@@ -549,6 +565,15 @@ class DeviceRetriever:
         it, self._tile_n = _pad_items(it, self.n_total, tile_n)
         self._items = jax.device_put(jnp.asarray(it))
 
+    @property
+    def lane_dim(self) -> int:
+        """Query lane width this retriever's compiled programs take.
+        ``topk`` accepts queries already padded to this width unchanged
+        (``_dispatch_topk``'s lane pad is then a no-op), which is what
+        lets the device-resident pipeline's gathered query matrix hand
+        off with zero re-pad."""
+        return int(self._items.shape[1])
+
     def topk(self, queries, k: int):
         """(values [B, k], indices [B, k]) — indices -1 beyond catalog."""
         q = np.asarray(queries, dtype=np.float32)
@@ -642,6 +667,13 @@ class ShardedDeviceRetriever:
         # host->target-device transfer per shard (jnp.asarray here would
         # bounce every shard through the default device first)
         self._token = next(_RETRIEVER_TOKENS)  # EXEC_CACHE key namespace
+
+    @property
+    def lane_dim(self) -> int:
+        """Query lane width (queries pre-padded to it pass through
+        ``_dispatch_topk``'s lane pad unchanged — the pipeline's gather
+        handoff contract, same as ``DeviceRetriever.lane_dim``)."""
+        return int(self._items.shape[1])
 
     def _call_for(self, b_pad: int, k_local: int, k_out: int, *,
                   pin: bool = False):
@@ -851,10 +883,31 @@ class RetrievalServingMixin:
     def batch_recommend(self, users: list, nums: list) -> list[list[tuple[str, float]]]:
         """Per-user top-N for a whole micro-batch in one device call;
         unknown users get []. The single home of the unknown-user/kmax/
-        trim dance for every retrieval-serving model's batch_predict."""
+        trim dance for every retrieval-serving model's batch_predict.
+
+        With a serving pipeline attached (ISSUE 16), the host side of
+        this shrinks to ONE vectorized id->row translation: the factor
+        gather, padding and scoring all run in the pipeline's compiled
+        device programs. The compacted row batch and the trim dance are
+        identical to the legacy path, so results are bit-for-bit the
+        same (the capture/replay parity tests pin it)."""
         uids = getattr(self, self._query_ids_attr)
         qmat = getattr(self, self._query_attr)
         out: list = [[] for _ in users]
+        pipe = getattr(self, "_pipeline", None)
+        if pipe is not None and pipe.n_rows == len(qmat):
+            rows = uids.map_array(users)
+            known = np.flatnonzero(rows >= 0)
+            if known.size == 0:
+                return out
+            kmax = max(max(nums[j] for j in known), 0)
+            vals, idx = pipe.topk_rows(rows[known], kmax)
+            inv = getattr(self, self._retrieval_ids_attr).inverse
+            for j, vr, ir in zip(known.tolist(), vals, idx):
+                rec = [(inv[int(i)], float(v))
+                       for v, i in zip(vr, ir) if i >= 0]
+                out[j] = rec[: max(nums[j], 0)]
+            return out
         known = [(j, uids.get(u)) for j, u in enumerate(users)]
         known = [(j, r) for j, r in known if r is not None]
         if not known:
@@ -888,6 +941,20 @@ class RetrievalServingMixin:
             getattr(self, self._retrieval_attr), interpret=interpret,
             **params)
 
+    def attach_pipeline(self) -> None:
+        """Make the QUERY side of serving device-resident too (ISSUE
+        16): upload the user-factor table into a ServingPipeline over
+        the already-attached retriever, so ``batch_recommend`` ships
+        only int32 row indices per request. Requires a retriever
+        (exact, ANN or sharded — the pipeline adapts); /reload builds a
+        fresh bundle and re-attaches, delta patches ``refresh`` the
+        table copy-on-write without invalidating compiled programs."""
+        from .pipeline import ServingPipeline
+
+        self._pipeline = ServingPipeline(
+            getattr(self, self._query_attr),
+            getattr(self, "_retriever", None))
+
     def attach_sharded_retriever(self, mesh, *, axis: str = "model") -> None:
         """Serve top-N from a catalog SHARDED over ``mesh``'s ``axis`` —
         same serving surface, ShardedDeviceRetriever underneath. For
@@ -919,6 +986,7 @@ class RetrievalServingMixin:
         # device arrays and derived caches never enter MODELDATA
         state.pop("_retriever", None)
         state.pop("_sim_retriever", None)
+        state.pop("_pipeline", None)
         state.pop("_vtv_cache", None)
         state.pop("_cn_cache", None)
         return state

@@ -644,6 +644,7 @@ def cmd_deploy(args) -> int:
         capture_max_mb=args.capture_max_mb,
         shadow_target=args.shadow_target,
         shadow_sample=args.shadow_sample,
+        serving_pipeline=args.serving_pipeline,
     )
     return 0
 
@@ -1622,6 +1623,15 @@ def build_parser() -> argparse.ArgumentParser:
                          "serves from the quantized IVF index (exact "
                          "fallback below its min-items floor), 'exact' "
                          "forces brute-force scoring")
+    sp.add_argument("--serving-pipeline", choices=["pipelined", "legacy"],
+                    default="pipelined",
+                    help="'pipelined' (default) serves through the "
+                         "device-resident dispatch pipeline: the user "
+                         "factor table lives on device, requests ship "
+                         "int32 row indices, and the full pad-bucket "
+                         "batch lattice is precompiled at deploy time; "
+                         "'legacy' keeps the pre-pipeline host dispatch "
+                         "(per-batch gather/pad/upload) for comparison")
     sp.add_argument("--deadline-ms", type=float, default=0.0,
                     help="default end-to-end deadline per query in ms "
                          "(expired queries answer 504; 0 disables; the "

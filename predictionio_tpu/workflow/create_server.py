@@ -173,6 +173,14 @@ class Deployed:
     retriever_axis: str = "model"
     prewarm_batch: int = 0  # pre-compile executables for this batch ceiling
     retrieval: dict | None = None
+    #: ISSUE 16: "pipelined" (default) serves through the device-resident
+    #: ServingPipeline — the query factor table lives on device, requests
+    #: ship int32 row indices, and exact 1-way serving attaches the
+    #: compiled retriever on EVERY backend (the XLA program off-TPU).
+    #: "legacy" preserves the pre-16 behavior exactly (host gather +
+    #: per-batch upload; host scoring for exact 1-way off TPU) for the
+    #: bench comparison and as an operational escape hatch.
+    serving_pipeline: str = "pipelined"
     # ISSUE 13 provenance facts, stamped at rehydration time: the model
     # blob's content hash (storage metadata checksum) and a digest over
     # the executable-cache keys this bundle compiled — together they
@@ -215,8 +223,14 @@ class Deployed:
             self.blob_sha = None
 
         mode = str((self.retrieval or {}).get("mode", "exact")).lower()
+        pipelined = str(self.serving_pipeline).lower() != "legacy"
+        # retrieval: {"device": true} forces the compiled exact retriever
+        # off-TPU even on the legacy path — the knob the parity harness
+        # uses so a legacy capture and a pipelined replay score through
+        # the same executable family (additive: default unchanged)
+        force_device = bool((self.retrieval or {}).get("device"))
         if (jax.default_backend() != "tpu" and self.retriever_mesh is None
-                and mode != "ann"):
+                and mode != "ann" and not pipelined and not force_device):
             return
         for model in self.result.models:
             mesh = None
@@ -237,9 +251,12 @@ class Deployed:
             else:
                 attach = getattr(model, "attach_retriever", None)
                 args, kwargs = (), {}
-                if jax.default_backend() != "tpu":
+                if (jax.default_backend() != "tpu" and not pipelined
+                        and not force_device):
                     # auto resolved to 1-way on a non-TPU backend: host
                     # scoring is the exact single-device path there
+                    # (legacy; the pipeline serves through the compiled
+                    # XLA program on every backend)
                     attach = None
             if attach is not None:
                 try:
@@ -252,6 +269,23 @@ class Deployed:
                 except Exception:  # pragma: no cover - serving must not die
                     log.exception("device retriever attach failed; "
                                   "serving falls back to host scoring")
+            if pipelined and getattr(model, "_retriever", None) is not None:
+                ap = getattr(model, "attach_pipeline", None)
+                # models without a query-factor table (similarity-only)
+                # have no query side to make device-resident: skip, the
+                # retriever alone is their whole serving path
+                if getattr(model, getattr(model, "_query_attr", ""),
+                           None) is None:
+                    ap = None
+                if ap is not None:
+                    try:
+                        ap()
+                        log.info("serving pipeline attached to %s (%s)",
+                                 type(model).__name__,
+                                 model._pipeline.stats()["mode"])
+                    except Exception:  # pragma: no cover - must not die
+                        log.exception("serving pipeline attach failed; "
+                                      "falling back to legacy dispatch")
         if self.prewarm_batch > 0:
             self._prewarm()
 
@@ -260,11 +294,25 @@ class Deployed:
         real query (and the first full micro-batch) never pays a compile.
         The micro-batcher produces two hot shapes: a lone query (pad 1)
         and a full window (pad ``prewarm_batch``); both are pinned in the
-        executable cache (ops/retrieval.py EXEC_CACHE)."""
+        executable cache (ops/retrieval.py EXEC_CACHE).
+
+        Pipelined serving (ISSUE 16) precompiles the FULL pad-bucketed
+        batch lattice instead — every power-of-two bucket up to the
+        micro-batcher's ceiling — so an adaptive window that dispatches
+        a partial batch never hits a cold executable; the pipeline's
+        prewarm also allocates the pinned staging pairs and accounts
+        them in the device ledger."""
         sizes = sorted({1, self.prewarm_batch})
+        if str(self.serving_pipeline).lower() != "legacy":
+            lattice = {1, self.prewarm_batch}
+            b = 8
+            while b < self.prewarm_batch:
+                lattice.add(b)
+                b *= 2
+            sizes = sorted(lattice)
         warmed_keys: list = []
         for model in self.result.models:
-            for attr in ("_retriever", "_sim_retriever"):
+            for attr in ("_retriever", "_sim_retriever", "_pipeline"):
                 r = getattr(model, attr, None)
                 if r is None or not hasattr(r, "prewarm"):
                     continue
@@ -335,6 +383,7 @@ class EngineServer:
         shadow_target: str | None = None,
         shadow_sample: float = 1.0,
         variant_id: str = "default",
+        serving_pipeline: str = "pipelined",
     ):
         self.engine = engine
         self.ctx = ctx or Context(mode="Serving")
@@ -349,18 +398,22 @@ class EngineServer:
         #: their blob was corrupt or unloadable — surfaced in
         #: /health.json and /stats.json so operators see the quarantine
         self.deploy_skips: list[dict] = []
+        self.serving_pipeline = (str(serving_pipeline).lower()
+                                 if serving_pipeline else "pipelined")
         if fallback:
             inst, result, self.deploy_skips = self._deploy_with_fallback(instance)
             self.deployed = Deployed(
                 inst, result,
                 retriever_mesh=retriever_mesh, retriever_axis=retriever_axis,
-                prewarm_batch=batch_max, retrieval=retrieval)
+                prewarm_batch=batch_max, retrieval=retrieval,
+                serving_pipeline=self.serving_pipeline)
         else:  # explicitly pinned instance: fail loud, never substitute
             self.deployed = Deployed(
                 instance,
                 prepare_deploy(engine, instance, self.ctx, engine_dir=engine_dir),
                 retriever_mesh=retriever_mesh, retriever_axis=retriever_axis,
-                prewarm_batch=batch_max, retrieval=retrieval)
+                prewarm_batch=batch_max, retrieval=retrieval,
+                serving_pipeline=self.serving_pipeline)
         self.feedback_url = feedback_url
         self.access_key = access_key
         # lifecycle-owned feedback publisher: one shared session, tracked
@@ -978,7 +1031,8 @@ class EngineServer:
                          prewarm_batch=self.batch_max,
                          # /reload preserves the ANN configuration (and
                          # rebuilds the index over the fresh factors)
-                         retrieval=self.deployed.retrieval)
+                         retrieval=self.deployed.retrieval,
+                         serving_pipeline=self.deployed.serving_pipeline)
         # ISSUE 10: reconcile outstanding delta patches before the swap.
         # Deltas for users the fresh instance trained are superseded
         # (training saw their journaled events) and are discarded; deltas
@@ -1053,6 +1107,18 @@ class EngineServer:
                                  for _, v in appends])
                 clone.user_ids = type(ids)(mapping)
             clone.user_factors = factors
+            pipe = getattr(clone, "_pipeline", None)
+            if pipe is not None:
+                # ISSUE 16: the epoch bump re-uploads the device query
+                # table copy-on-write — compiled programs stay valid
+                # (capacity headroom absorbs appended users), in-flight
+                # dispatches keep the table they were launched with
+                try:
+                    clone._pipeline = pipe.refresh(factors)
+                except Exception:  # noqa: BLE001 — serving must not die
+                    log.exception("pipeline refresh failed; detaching "
+                                  "(legacy dispatch until next reload)")
+                    clone._pipeline = None
             new_models[mi] = clone
             applied.update(u for u, _ in appends)
             applied.update(u for u, v in patches.items()
@@ -1163,6 +1229,20 @@ class EngineServer:
                     "sharded": type(r).__name__ == "ShardedDeviceRetriever"}
         return None
 
+    def _pipeline_stats(self, bundle: "Deployed | None" = None,
+                        ) -> dict | None:
+        """The configured dispatch path plus the first attached
+        ServingPipeline's stats() (ISSUE 16; overlap ratio, staging
+        pool, table capacity) — stats absent when nothing attached."""
+        bundle = bundle if bundle is not None else self.deployed
+        block = {"servingPipeline": bundle.serving_pipeline}
+        for model in bundle.result.models:
+            p = getattr(model, "_pipeline", None)
+            if p is not None:
+                block.update(p.stats())
+                break
+        return block
+
     def variant_stats(self) -> dict:
         """The per-variant slice of serving_stats (ISSUE 14): what is
         distinct about THIS variant — counters, mode, SLO, admission,
@@ -1264,6 +1344,9 @@ class EngineServer:
             # ISSUE 7: the active retrieval mode + ANN index facts
             # (cells / nprobe / quantize / build seconds / fallback)
             "retrieval": self._retrieval_stats(bundle),
+            # ISSUE 16: device-resident dispatch posture (overlap ratio,
+            # staging pool, capacity); None on the legacy path
+            "pipeline": self._pipeline_stats(bundle),
             "admission": (self.admission.stats()
                           if self.admission is not None else None),
             "resilience": {

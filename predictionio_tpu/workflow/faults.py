@@ -91,6 +91,13 @@ Sites instrumented in this repo:
   site; an ``error`` with ``times=1`` fails exactly one trial and the
   leaderboard must show that trial FAILED while every other trial
   completes and a winner still promotes)
+- ``pipeline.swap``          — the double-buffer handoff in the
+  device-resident serving pipeline (``ops/pipeline.ServingPipeline
+  .topk_rows``), after the staging buffer is filled and before the
+  device step takes it (sync site; arm a ``hang`` to hold one pinned
+  staging buffer hostage — the batch must degrade through the
+  micro-batcher's watchdog while later dispatches swap to the second
+  buffer or a transient one, never wedging the pool)
 
 A fault is armed per site with a kind:
 
@@ -145,6 +152,7 @@ SITES: tuple[str, ...] = (
     "stream.fold_in",
     "stream.publish",
     "tune.trial",
+    "pipeline.swap",
 )
 
 #: chaos runs must always be measurable: one counter series per site,

@@ -271,7 +271,19 @@ class MicroBatcher:
             # a window can't fill a batch at this rate; with the pipeline
             # saturated waiting is free, otherwise dispatch now
             return 0.0
-        return min(self.window_s, need * iv)
+        w = min(self.window_s, need * iv)
+        # Deadline headroom clamp (ISSUE 16 satellite): when EVERY
+        # queued entry carries a deadline, never hold the batch past the
+        # tightest one minus the expected dispatch wall — admission
+        # already accepted these queries, so a slow-arrival EWMA must
+        # not expire them in the queue. Entries without deadlines leave
+        # the window alone (no deadline means no headroom to protect).
+        if self._pending and all(len(t) > 2 and t[2] is not None
+                                 for t in self._pending):
+            margin = self._ewma_dispatch_s or 0.0
+            headroom = min(t[2] for t in self._pending) - now - margin
+            w = max(0.0, min(w, headroom))
+        return w
 
     def set_max_inflight(self, n: int) -> None:
         """Resize the dispatch pipeline (degraded mode shrinks it, recovery

@@ -285,8 +285,18 @@ def test_deployed_auto_mesh_and_ann_attach(rng):
     m2.item_factors = m.item_factors
     m2.item_ids = m.item_ids
     d2 = Deployed(None, SimpleNamespace(models=[m2]), retriever_mesh="auto")
-    # cost model says 1-way at 2k rows; on CPU that is host scoring
-    assert getattr(m2, "_retriever", None) is None
+    # cost model says 1-way at 2k rows; the pipelined default (ISSUE 16)
+    # serves the compiled exact program on EVERY backend, CPU included
+    assert isinstance(getattr(m2, "_retriever", None), DeviceRetriever)
+
+    m3 = M()
+    m3.item_factors = m.item_factors
+    m3.item_ids = m.item_ids
+    d3 = Deployed(None, SimpleNamespace(models=[m3]), retriever_mesh="auto",
+                  serving_pipeline="legacy")
+    # the legacy escape hatch keeps the pre-16 posture: 1-way on CPU is
+    # host scoring, the exact baseline
+    assert getattr(m3, "_retriever", None) is None
 
 
 def test_serve_bench_ann_sweep_smoke(rng):

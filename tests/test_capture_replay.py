@@ -365,7 +365,9 @@ def test_e2e_capture_replay_parity_then_delta_diff(tmp_path, rng):
         prov = records[0]["provenance"]
         assert prov["engineInstanceId"] == inst.id
         assert str(prov["modelBlobSha256"]).startswith("sha256:")
-        assert prov["retrieval"]["mode"] == "host"
+        # ISSUE 16: the pipelined default serves the compiled exact
+        # retriever on every backend, so the mode is "exact", not "host"
+        assert prov["retrieval"]["mode"] == "exact"
 
         # -- replay against the SAME live instance: total parity -------
         report = replay_records(records, target=st.url)
@@ -443,6 +445,135 @@ def test_replay_in_process_ann_full_cover_delegate(tmp_path, rng):
     # the in-process issuer reports its own provenance: same blob, same
     # epoch -> empty delta even across two server constructions
     assert report["provenance"]["delta"] == {}
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 16 parity gate: legacy capture -> pipelined replay, bitwise
+
+
+def _capture_legacy(tmp_path, engine, inst, retrieval, *, name: str,
+                    delta: dict | None = None):
+    """Capture B=1 golden traffic on a LEGACY-path server; when ``delta``
+    is given, patch mid-stream so the tail of the capture carries
+    patchEpoch 1 (the delta-patched variant capture)."""
+    from predictionio_tpu.workflow.create_server import (
+        EngineServer,
+        create_engine_server_app,
+    )
+
+    cap_dir = tmp_path / name
+    legacy = EngineServer(engine, inst, capture_dir=str(cap_dir),
+                          capture_sample=1.0, retrieval=retrieval,
+                          serving_pipeline="legacy")
+    st = ServerThread(lambda: create_engine_server_app(legacy))
+    try:
+        users = [f"u{i}" for i in range(8)] + ["nobody"]
+        for u in users:
+            r = requests.post(st.url + "/queries.json",
+                              json={"user": u, "num": 4})
+            assert r.status_code == 200
+        n = len(users)
+        if delta is not None:
+            r = requests.post(st.url + "/reload/delta",
+                              json={"users": delta})
+            assert r.status_code == 200
+            assert r.json()["appliedCount"] == len(delta)
+            for u in ("u0", "u1", "u5"):
+                r = requests.post(st.url + "/queries.json",
+                                  json={"user": u, "num": 4})
+                assert r.status_code == 200
+            n += 3
+        requests.post(st.url + "/capture/stop")
+    finally:
+        st.stop()
+    records = list(iter_capture(cap_dir))
+    assert len(records) == n
+    return records
+
+
+def test_pipelined_replay_of_legacy_capture_bitwise(tmp_path, rng):
+    """ISSUE 16 parity gate: a golden-traffic capture taken on the
+    LEGACY serving path replays 100% bitwise on the device-resident
+    pipelined path — including a delta-patched variant stretch. The
+    capture server forces ``retrieval: {"device": true}`` so both paths
+    score through the same compiled-executable family (host numpy vs
+    XLA differ in reduction order at B=1; the pipeline is pinned
+    against the compiled program, which is the TPU serving reality)."""
+    from predictionio_tpu.workflow.create_server import EngineServer
+
+    engine, inst = _train_quickstart(tmp_path, rng, "pipepartest")
+    retrieval = {"mode": "exact", "device": True}
+    pre = _capture_legacy(tmp_path, engine, inst, retrieval, name="cap0")
+
+    fresh = EngineServer(engine, inst, batch_window_ms=0,
+                         retrieval=retrieval)  # pipelined default
+    model = fresh.deployed.result.models[0]
+    assert getattr(model, "_pipeline", None) is not None, \
+        "pipeline did not attach — parity test would compare legacy/legacy"
+    report = replay_records(pre, server=fresh)
+    assert report["total"] == len(pre) and report["skipped"] == 0
+    assert report["tiers"]["bitwise"] == len(pre)
+    assert report["parityPct"] == 100.0
+    # the two bundles warm DIFFERENT executables (that is the point) so
+    # the exec digest moves; everything else — blob, instance, epoch —
+    # must agree
+    assert set(report["provenance"]["delta"]) <= {"execCacheKey"}
+
+    # delta-patched variant: the legacy capture carries patchEpoch 1 on
+    # its tail; the pipelined replayer applies the same patch (the
+    # copy-on-write refresh — no recompile) and matches bitwise
+    rank = int(np.asarray(model.user_factors).shape[1])
+    patch = {"u1": (3.5 * np.ones(rank)).tolist(),
+             "u5": (-2.0 * np.ones(rank)).tolist()}
+    tagged = _capture_legacy(tmp_path, engine, inst, retrieval,
+                             name="cap1", delta=patch)
+    pre_d = [r for r in tagged if r["provenance"]["patchEpoch"] == 0]
+    post_d = [r for r in tagged if r["provenance"]["patchEpoch"] == 1]
+    assert len(post_d) == 3
+
+    from predictionio_tpu.ops.retrieval import EXEC_CACHE
+
+    fresh2 = EngineServer(engine, inst, batch_window_ms=0,
+                          retrieval=retrieval)
+    rep_pre = replay_records(pre_d, server=fresh2)
+    assert rep_pre["tiers"]["bitwise"] == len(pre_d)
+    misses0 = EXEC_CACHE.stats()["misses"]
+    out = fresh2.apply_delta(patch)
+    assert out["appliedCount"] == len(patch)
+    pm = fresh2.deployed.result.models[0]
+    assert getattr(pm, "_pipeline", None) is not None
+    rep_post = replay_records(post_d, server=fresh2)
+    assert rep_post["tiers"]["bitwise"] == len(post_d)
+    assert rep_post["parityPct"] == 100.0
+    # epoch 1 == epoch 1: the patch itself leaves no provenance delta
+    assert "patchEpoch" not in rep_post["provenance"]["delta"]
+    # the refresh was copy-on-write: serving the patched table compiled
+    # nothing new
+    assert EXEC_CACHE.stats()["misses"] == misses0
+
+
+def test_pipelined_replay_of_legacy_ann_capture_bitwise(tmp_path, rng):
+    """ISSUE 16 parity gate, ANN-mode variant: with nprobe >= n_cells
+    the index delegates to exact scoring, and the pipeline's gather
+    front end hands the ANN retriever a bit-identical query matrix —
+    a legacy ANN capture replays 100% bitwise through the pipelined
+    gather dispatch."""
+    from predictionio_tpu.workflow.create_server import EngineServer
+
+    engine, inst = _train_quickstart(tmp_path, rng, "pipeanntest")
+    retrieval = {"mode": "ann", "min_items": 0, "n_cells": 4, "nprobe": 99}
+    records = _capture_legacy(tmp_path, engine, inst, retrieval,
+                              name="capann")
+    fresh = EngineServer(engine, inst, batch_window_ms=0,
+                         retrieval=retrieval)
+    model = fresh.deployed.result.models[0]
+    pipe = getattr(model, "_pipeline", None)
+    assert pipe is not None and pipe.stats()["mode"] == "gather"
+    report = replay_records(records, server=fresh)
+    assert report["total"] == len(records)
+    assert report["tiers"]["bitwise"] == len(records)
+    assert report["parityPct"] == 100.0
+    assert set(report["provenance"]["delta"]) <= {"execCacheKey"}
 
 
 # ---------------------------------------------------------------------------

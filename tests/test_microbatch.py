@@ -283,6 +283,47 @@ class TestAdaptiveWindow:
         mb._pending = [(i, None) for i in range(4)]
         assert mb._choose_window(t) == 0.0
 
+    def _slow_stream(self, mb, t=100.0, gap=0.04, n=20):
+        """A ~25 Hz arrival stream: the EWMA alone would open a LONG
+        window for a partial batch."""
+        for _ in range(n):
+            mb._note_arrival(t)
+            t += gap
+        return t
+
+    def test_deadline_headroom_clamps_window(self):
+        """ISSUE 16 satellite: when every queued entry carries a
+        deadline, the window never holds the batch past the tightest
+        deadline minus the expected dispatch wall — admission accepted
+        these queries; the EWMA must not expire them in the queue."""
+        mb = self._mb(window_s=5.0, max_batch=64)
+        t = self._slow_stream(mb)
+        mb._ewma_dispatch_s = 0.01
+        mb._pending = [(i, None, t + 0.05 + 0.01 * i, t, None)
+                       for i in range(3)]
+        w = mb._choose_window(t)
+        # tightest deadline 50 ms out, minus the 10 ms dispatch margin
+        assert w == pytest.approx(0.04)
+
+    def test_deadline_clamp_skipped_when_any_entry_deadline_free(self):
+        """An entry without a deadline means there is no headroom to
+        protect: the rate-scaled window stands."""
+        mb = self._mb(window_s=5.0, max_batch=64)
+        t = self._slow_stream(mb)
+        mb._ewma_dispatch_s = 0.01
+        mb._pending = [(0, None, t + 0.05, t, None), (1, None)]
+        assert mb._choose_window(t) > 0.04
+
+    def test_expired_deadline_dispatches_immediately(self):
+        """Headroom already spent -> window 0: ship the batch NOW so
+        the deadline rejection (or the tail of the budget) happens in
+        dispatch, not in the queue."""
+        mb = self._mb(window_s=5.0, max_batch=64)
+        t = self._slow_stream(mb)
+        mb._ewma_dispatch_s = 0.01
+        mb._pending = [(0, None, t - 0.001, t, None)]
+        assert mb._choose_window(t) == 0.0
+
     def test_lone_query_not_held_to_ceiling(self):
         """End to end: with a 5 s ceiling, an idle adaptive batcher must
         answer a lone query in wire time, not ceiling time."""

@@ -232,6 +232,46 @@ class TestExecutableCache:
         assert rebuilt == []  # survived every eviction round
         assert c.stats()["pinned"] == 1
 
+    def test_double_build_race_compiles_once(self):
+        """ISSUE 16 satellite: two threads missing the same key must
+        compile it ONCE — the loser waits on the per-key build lock and
+        takes the winner's entry as a hit. Pinned by exactly one
+        pio_xla_compile_pipeline_seconds observation."""
+        import threading
+        import time as _time
+
+        from predictionio_tpu.obs.device import COMPILE_HISTOGRAMS
+
+        c = self._cache()
+        key = ("pipeline", 0, "race", 8, 8)
+        count0 = COMPILE_HISTOGRAMS["pipeline"].snapshot()["count"]
+        barrier = threading.Barrier(2)
+        built = []
+
+        def build():
+            built.append(1)
+            _time.sleep(0.05)  # long enough for the loser to pile in
+            return "exe"
+
+        results = [None, None]
+
+        def worker(i):
+            barrier.wait()
+            results[i] = c.get_or_build(key, build)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(10)
+        assert built == [1], "racing threads burned a duplicate compile"
+        assert results == ["exe", "exe"]
+        s = c.stats()
+        assert s["misses"] == 1 and s["hits"] == 1
+        after = COMPILE_HISTOGRAMS["pipeline"].snapshot()["count"]
+        assert after - count0 == 1  # the ledger saw ONE compile
+
 
 @pytest.mark.parametrize("make", [
     pytest.param(lambda items: DeviceRetriever(items), id="single"),
@@ -253,6 +293,58 @@ def test_prewarm_precompiles_serving_shapes(rng, make):
     after = EXEC_CACHE.stats()
     assert after["misses"] == before["misses"]
     assert after["hits"] >= before["hits"] + 2
+
+
+def test_dispatch_topk_pad_bucket_lattice(rng):
+    """ISSUE 16 satellite: ``_dispatch_topk`` maps every (b, k) in
+    b 1..65 x k {1, 10, 64} onto the MINIMAL pad bucket (power-of-two
+    batch >= 8, k rounded to 8s), records the padding waste for every
+    dispatch, and — after a prewarm of the lattice — never compiles at
+    request time."""
+    from predictionio_tpu.obs.device import LEDGER
+    from predictionio_tpu.ops.retrieval import (
+        EXEC_CACHE,
+        _dispatch_topk,
+        _query_shapes,
+    )
+
+    n_total = 600
+    seen: list[tuple[int, int]] = []
+
+    def invoke(q_padded, k_pad):
+        seen.append((q_padded.shape[0], k_pad))
+        return (np.zeros((q_padded.shape[0], k_pad), np.float32),
+                np.zeros((q_padded.shape[0], k_pad), np.int32)), False
+
+    waste0 = LEDGER.snapshot()["paddingWaste"]["count"]
+    dispatches = 0
+    for b in range(1, 66):
+        q = np.zeros((b, 16), np.float32)
+        for k in (1, 10, 64):
+            k_eff = min(k, n_total)
+            vals, idx = _dispatch_topk(q, n_total, k, invoke)
+            dispatches += 1
+            b_pad, k_pad = _query_shapes(b, k_eff, n_total)
+            assert seen[-1] == (b_pad, k_pad)
+            assert k_pad == min(((k_eff + 7) // 8) * 8, n_total)
+            assert b_pad >= max(b, 8)
+            assert b_pad == 8 or b_pad < 2 * b  # minimal bucket
+            assert vals.shape == (b, k_eff)  # un-padded back out
+    assert LEDGER.snapshot()["paddingWaste"]["count"] - waste0 == dispatches
+    # the whole lattice collapses onto a handful of compiled shapes
+    assert len(set(seen)) <= 5 * 3
+
+    # and against a REAL retriever: prewarming those buckets means zero
+    # request-time compiles across the full lattice
+    items = rng.standard_normal((n_total, 16)).astype(np.float32)
+    ret = DeviceRetriever(items)
+    ret.prewarm(batch_sizes=(1, 16, 32, 64, 65), ks=(1, 10, 64))
+    before = EXEC_CACHE.stats()["misses"]
+    for b in (1, 7, 8, 9, 33, 65):
+        for k in (1, 10, 64):
+            ret.topk(rng.standard_normal((b, 16)).astype(np.float32), k)
+    assert EXEC_CACHE.stats()["misses"] == before, \
+        "a lattice shape compiled at request time after prewarm"
 
 
 def test_serve_bench_sweep_smoke(rng):
