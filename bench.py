@@ -2017,6 +2017,291 @@ print("DPIPE overlap %.4f %d %d" % (
             "pipeline_transient_staging": overlap[2]}
 
 
+def serving_fleet_bench() -> dict:
+    """ISSUE 17 gate: the replicated serving fleet — M real `pio deploy`
+    replica subprocesses (shared durable storage, blob trained once)
+    behind the FleetRouter, measured as matched-pair saturated qps at
+    1/2/4 replicas plus a timed kill-a-replica window.
+
+    What fleet 'scaling' means on this host (PR-6 platform hygiene, same
+    stance as the dispatch-pipeline section): replicas are separate
+    PROCESSES, so qps multiplies only when the host has cores to run
+    them side by side. The full-scale gates — >= 1.8x qps at 2 replicas
+    and >= 3x at 4 — arm when the host can express that parallelism
+    (cores >= 4 and cores >= 8 respectively); below that, raw qps and
+    the core count are stamped so the artifact reads honestly, and the
+    only scaling gate is the no-collapse floor (adding replicas must
+    never cost more than half the single-replica qps to fan-out
+    overhead). The failover gates are host-independent and always HARD:
+    a SIGKILLed replica mid-hammer drops ZERO in-deadline requests
+    (hedged onto the survivor), and its breaker opens within 2 s."""
+    code = r"""
+import asyncio, json, os, shutil, signal, socket, sys, tempfile
+import threading, time
+sys.path.insert(0, os.environ["REPO"])
+home = tempfile.mkdtemp(prefix="pio_fleet_bench_")
+os.environ["PIO_HOME"] = home  # before imports: durable sqlite/localfs
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import requests
+from aiohttp import web
+
+from predictionio_tpu.storage import Storage
+from predictionio_tpu.tools.cli import main as pio
+from predictionio_tpu.workflow.fleet import (
+    FleetRouter, create_fleet_app, spawn_replicas)
+
+cores = os.cpu_count() or 1
+print("FLEET cores %d" % cores, flush=True)
+
+# -- train once into the shared durable store ------------------------------
+t0 = time.time()
+assert pio(["app", "new", "fleetbench"]) == 0
+app = Storage.get_metadata().app_get_by_name("fleetbench")
+rng = np.random.default_rng(17)
+nu, ni, n = 1000, 300, 12_000
+users = rng.integers(0, nu, n)
+items = rng.integers(0, ni, n)
+vals = np.round(rng.random(n) * 9 + 1) / 2
+jl = os.path.join(home, "events.jsonl")
+with open(jl, "w") as f:
+    for i in range(n):
+        f.write(json.dumps({
+            "event": "rate", "entityType": "user",
+            "entityId": "u%d" % users[i],
+            "targetEntityType": "item", "targetEntityId": "i%d" % items[i],
+            "properties": {"rating": float(vals[i])},
+            "eventTime": "2020-01-01T00:00:00Z"}) + "\n")
+assert pio(["import", "--appid", str(app.id), "--input", jl]) == 0
+engine_dir = os.path.join(home, "engine")
+shutil.copytree(os.path.join(os.environ["REPO"], "templates",
+                             "recommendation"), engine_dir)
+ej = os.path.join(engine_dir, "engine.json")
+variant = json.loads(open(ej).read())
+variant["datasource"]["params"]["app_name"] = "fleetbench"
+open(ej, "w").write(json.dumps(variant))
+assert pio(["train", "--engine-dir", engine_dir]) == 0
+print("FLEET train_s %.1f" % (time.time() - t0), flush=True)
+
+# -- 4 real replica subprocesses, one blob pull each -----------------------
+s = socket.socket()
+s.bind(("127.0.0.1", 0))
+base_port = s.getsockname()[1]
+s.close()
+procs = spawn_replicas(engine_dir, 4, base_port, env=dict(os.environ))
+urls = ["http://127.0.0.1:%d" % (base_port + i) for i in range(4)]
+try:
+    t0 = time.time()
+    for u in urls:
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            try:
+                if requests.get(u + "/health.json",
+                                timeout=2).json().get("ready"):
+                    break
+            except requests.RequestException:
+                pass
+            time.sleep(0.2)
+        else:
+            raise AssertionError("replica %s never became ready" % u)
+    print("FLEET ready_s %.1f" % (time.time() - t0), flush=True)
+
+    def start_router(replica_urls):
+        router = FleetRouter(replica_urls, probe_interval_s=0.25,
+                             breaker_reset_s=0.5, dispatch_timeout_s=8.0,
+                             max_hedges=1)
+        loop = asyncio.new_event_loop()
+        ready, holder = threading.Event(), {}
+        async def _start():
+            runner = web.AppRunner(create_fleet_app(router))
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            holder["port"] = runner.addresses[0][1]
+            ready.set()
+        def _run():
+            asyncio.set_event_loop(loop)
+            loop.run_until_complete(_start())
+            loop.run_forever()
+        threading.Thread(target=_run, daemon=True).start()
+        assert ready.wait(30), "fleet router failed to start"
+        return router, holder["port"]
+
+    routers = {m: start_router(urls[:m]) for m in (1, 2, 4)}
+
+    def measure(port, seconds=2.0, nthreads=6):
+        stop = threading.Event()
+        counts, errs = [0] * nthreads, [0] * nthreads
+        def w(i):
+            sess = requests.Session()
+            k, ok, bad = i * 7919, 0, 0
+            url = "http://127.0.0.1:%d/queries.json" % port
+            while not stop.is_set():
+                k += 1
+                r = sess.post(url, json={"user": "u%d" % (k % 1000),
+                                         "num": 2}, timeout=10)
+                ok += r.status_code == 200
+                bad += r.status_code != 200
+            counts[i], errs[i] = ok, bad
+        ts = [threading.Thread(target=w, args=(i,)) for i in range(nthreads)]
+        t0 = time.perf_counter()
+        for t in ts: t.start()
+        time.sleep(seconds)
+        stop.set()
+        for t in ts: t.join(30)
+        return sum(counts) / (time.perf_counter() - t0), sum(errs)
+
+    targets = [("direct", base_port)] + [
+        ("r%d" % m, routers[m][1]) for m in (1, 2, 4)]
+    for _, port in targets:           # warm: TCP stacks, router sessions
+        measure(port, seconds=0.5, nthreads=2)
+    qps = {label: [] for label, _ in targets}
+    bad_total = 0
+    for _ in range(3):                # paired rounds: drift hits all four
+        for label, port in targets:
+            q, bad = measure(port)
+            qps[label].append(q)
+            bad_total += bad
+    def med(xs):
+        return sorted(xs)[len(xs) // 2]
+    for label, _ in targets:
+        print("FLEET qps_%s %.1f" % (label, med(qps[label])), flush=True)
+    print("FLEET qps_errors %d" % bad_total, flush=True)
+
+    # -- kill-a-replica window against the 2-replica router ----------------
+    router2, port2 = routers[2]
+    url2 = "http://127.0.0.1:%d/queries.json" % port2
+    recs, stop = [], threading.Event()
+    lock = threading.Lock()
+    t_base = time.perf_counter()
+    def hammer(i):
+        sess = requests.Session()
+        k = i * 104_729
+        while not stop.is_set():
+            k += 1
+            ts0 = time.perf_counter()
+            try:
+                r = sess.post(url2, json={"user": "u%d" % (k % 1000),
+                                          "num": 2},
+                              headers={"X-PIO-Deadline-Ms": "8000"},
+                              timeout=10)
+                st = r.status_code
+            except requests.RequestException:
+                st = -1
+            with lock:
+                recs.append((ts0 - t_base,
+                             (time.perf_counter() - ts0) * 1e3, st))
+    ts = [threading.Thread(target=hammer, args=(i,)) for i in range(4)]
+    for t in ts: t.start()
+    time.sleep(1.5)                                  # steady state
+    t_kill = time.perf_counter() - t_base
+    os.kill(procs[1].pid, signal.SIGKILL)
+    t0 = time.perf_counter()
+    while (router2.replicas[1].breaker != "open"
+           and time.perf_counter() - t0 < 10):
+        time.sleep(0.02)
+    breaker_open_s = time.perf_counter() - t0
+    time.sleep(3.0)                                  # failover + steady
+    stop.set()
+    for t in ts: t.join(30)
+
+    def p99(xs):
+        return sorted(xs)[min(len(xs) - 1, int(0.99 * len(xs)))]
+    steady = [l for t, l, st in recs if t < t_kill and st == 200]
+    window = [l for t, l, st in recs if t_kill <= t < t_kill + 2.0
+              and st == 200]
+    dropped = sum(1 for _, _, st in recs if st != 200)
+    from predictionio_tpu.obs.metrics import METRICS
+    hedges = int(METRICS.get("pio_fleet_hedges_total").value("rescued"))
+    print("FLEET kill_total %d" % len(recs), flush=True)
+    print("FLEET kill_dropped %d" % dropped, flush=True)
+    print("FLEET p99_steady_ms %.2f" % p99(steady), flush=True)
+    print("FLEET p99_failover_ms %.2f" % p99(window), flush=True)
+    print("FLEET breaker_open_s %.3f" % breaker_open_s, flush=True)
+    print("FLEET hedges_rescued %d" % hedges, flush=True)
+finally:
+    for p in procs:
+        try:
+            p.terminate()
+        except OSError:
+            pass
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except Exception:
+            p.kill()
+"""
+    rows = {r[0]: r[1:] for r in _run_tagged_child(code, "FLEET", 900)}
+    cores = int(rows["cores"][0])
+    q_direct = float(rows["qps_direct"][0])
+    q1, q2, q4 = (float(rows["qps_r%d" % m][0]) for m in (1, 2, 4))
+    qps_errors = int(rows["qps_errors"][0])
+    dropped = int(rows["kill_dropped"][0])
+    kill_total = int(rows["kill_total"][0])
+    p99_steady = float(rows["p99_steady_ms"][0])
+    p99_failover = float(rows["p99_failover_ms"][0])
+    breaker_open_s = float(rows["breaker_open_s"][0])
+    hedges = int(rows["hedges_rescued"][0])
+    scale2, scale4 = q2 / q1, q4 / q1
+    if qps_errors > 0:
+        raise RuntimeError(
+            f"serving fleet gate: {qps_errors} non-200 answers during the "
+            f"steady qps blocks — saturation alone must never drop queries")
+    if dropped > 0:
+        raise RuntimeError(
+            f"serving fleet gate: {dropped}/{kill_total} in-deadline "
+            f"requests dropped across the kill-a-replica window — failover "
+            f"must hedge every routed query onto the survivor")
+    if breaker_open_s > 2.0:
+        raise RuntimeError(
+            f"serving fleet gate: the killed replica's breaker took "
+            f"{breaker_open_s:.2f} s to open (> 2 s) — dead-peer detection "
+            f"regressed past one probe interval + dispatch failure")
+    if min(scale2, scale4) < 0.5:
+        raise RuntimeError(
+            f"serving fleet gate: fan-out collapse — qps x{scale2:.2f} at "
+            f"2 replicas / x{scale4:.2f} at 4 vs one replica (< 0.5x floor)")
+    if q1 < 0.3 * q_direct:
+        raise RuntimeError(
+            f"serving fleet gate: router passthrough {q1:.0f} qps is "
+            f"{q1 / q_direct:.2f}x the direct-to-replica {q_direct:.0f} "
+            f"(< 0.3x) — the routing hop costs more than the serving")
+    if cores >= 4 and scale2 < 1.8:
+        raise RuntimeError(
+            f"serving fleet gate: {cores} cores but 2 replicas serve only "
+            f"{scale2:.2f}x one replica's qps (< 1.8x)")
+    if cores >= 8 and scale4 < 3.0:
+        raise RuntimeError(
+            f"serving fleet gate: {cores} cores but 4 replicas serve only "
+            f"{scale4:.2f}x one replica's qps (< 3x)")
+    gate = ("armed" if cores >= 8
+            else "2x-only:cores<8" if cores >= 4
+            else f"deferred:cores={cores}<4")
+    log(f"serving fleet: qps {q1:.0f}/{q2:.0f}/{q4:.0f} at 1/2/4 replicas "
+        f"(x{scale2:.2f}/x{scale4:.2f}, scaling gate {gate}), direct "
+        f"{q_direct:.0f}; kill window {dropped}/{kill_total} dropped, "
+        f"breaker open {breaker_open_s * 1e3:.0f} ms, {hedges} hedge "
+        f"rescue(s), p99 {p99_steady:.1f} -> {p99_failover:.1f} ms")
+    return {"fleet_platform": "cpu",  # the child pins the cpu backend
+            "fleet_host_cores": cores,
+            "fleet_qps_direct": round(q_direct, 1),
+            "fleet_qps_1": round(q1, 1),
+            "fleet_qps_2": round(q2, 1),
+            "fleet_qps_4": round(q4, 1),
+            "fleet_qps_scale_2": round(scale2, 2),
+            "fleet_qps_scale_4": round(scale4, 2),
+            "fleet_scaling_gate": gate,
+            "fleet_router_passthrough": round(q1 / q_direct, 2),
+            "fleet_failover_dropped": dropped,
+            "fleet_failover_requests": kill_total,
+            "fleet_steady_p99_ms": round(p99_steady, 2),
+            "fleet_failover_p99_ms": round(p99_failover, 2),
+            "fleet_breaker_open_s": round(breaker_open_s, 3),
+            "fleet_hedges_rescued": hedges}
+
+
 def _cache_dir() -> str:
     d = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".xla_cache")
     os.makedirs(d, exist_ok=True)
@@ -2388,6 +2673,7 @@ def main() -> None:
         ("capture overhead", capture_overhead_bench, 600, False),
         ("multi-variant serving", multi_variant_bench, 600, False),
         ("dispatch pipeline", dispatch_pipeline_bench, 600, False),
+        ("serving fleet", serving_fleet_bench, 900, False),
     ]
     if platform != "tpu":
         # the e2e child pins itself to the host backend (PIO_PLATFORM),

@@ -645,7 +645,123 @@ def cmd_deploy(args) -> int:
         shadow_target=args.shadow_target,
         shadow_sample=args.shadow_sample,
         serving_pipeline=args.serving_pipeline,
+        prewarm_async=args.prewarm_async,
     )
+    return 0
+
+
+def cmd_fleet(args) -> int:
+    """ISSUE 17: the replicated serving fleet — start M replica
+    processes behind a routing tier, inspect per-replica health, and
+    drain a replica out of rotation."""
+    return {"start": _fleet_start, "status": _fleet_status,
+            "drain": _fleet_drain}[args.fleet_command](args)
+
+
+def _fleet_start(args) -> int:
+    from ..workflow.fleet import (run_fleet_router, spawn_replicas,
+                                  write_fleet_state)
+
+    router_ip = "127.0.0.1" if args.ip in ("0.0.0.0", "::") else args.ip
+    router_url = f"http://{router_ip}:{args.port}"
+    procs = []
+    if args.replica_urls:
+        # front EXISTING engine servers (e.g. on other hosts)
+        urls = [u.strip().rstrip("/")
+                for u in args.replica_urls.split(",") if u.strip()]
+    else:
+        if args.replicas < 1:
+            _die("--replicas must be >= 1")
+        extra = ["--engine-json", args.engine_json]
+        for tok in args.replica_arg or []:
+            extra.extend(tok.split())
+        procs = spawn_replicas(args.engine_dir, args.replicas,
+                               args.base_port, extra_args=tuple(extra))
+        urls = [f"http://127.0.0.1:{args.base_port + i}"
+                for i in range(args.replicas)]
+    write_fleet_state(router_url, [
+        {"name": f"r{i}", "url": u,
+         "pid": (procs[i].pid if i < len(procs) else None)}
+        for i, u in enumerate(urls)])
+    _ok(f"fleet: router on {router_url}, {len(urls)} replica(s): "
+        f"{', '.join(urls)}")
+    try:
+        run_fleet_router(
+            urls, ip=args.ip, port=args.port,
+            probe_interval_s=args.probe_interval_s,
+            breaker_reset_s=args.breaker_reset_s,
+            default_deadline_ms=args.deadline_ms,
+            max_hedges=args.max_hedges,
+            spillover_inflight=args.spillover_inflight,
+            journal_max=args.journal_max,
+            slo_drain_burn=args.slo_drain_burn,
+            canary_sample=args.canary_sample,
+            canary_max_mismatch=args.canary_max_mismatch,
+        )
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — SIGKILL the stragglers
+                p.kill()
+    return 0
+
+
+def _fleet_router_url(args) -> str:
+    if getattr(args, "router_url", None):
+        return args.router_url.rstrip("/")
+    from ..workflow.fleet import read_fleet_state
+
+    state = read_fleet_state()
+    if state and state.get("routerUrl"):
+        return str(state["routerUrl"]).rstrip("/")
+    return "http://127.0.0.1:8000"
+
+
+def _fleet_status(args) -> int:
+    import urllib.request
+
+    url = _fleet_router_url(args)
+    try:
+        with urllib.request.urlopen(f"{url}/fleet.json", timeout=5) as resp:
+            st = json.loads(resp.read().decode())
+    except Exception as e:  # noqa: BLE001
+        _die(f"fleet router unreachable at {url}: {e}")
+    _ok(f"fleet router {url}: epoch {st['fleetEpoch']}, "
+        f"{len(st['eligible'])}/{len(st['replicas'])} replica(s) eligible"
+        f"{' [DRAINING]' if st.get('draining') else ''}")
+    for r in st["replicas"]:
+        mark = ("eligible" if r["name"] in st["eligible"]
+                else "draining" if r["draining"] or r["adminDrained"]
+                else f"breaker {r['breaker']}" if r["breaker"] != "closed"
+                else "slo-drained" if r["sloDrained"]
+                else "not ready")
+        _ok(f"  {r['name']} {r['url']}: {r['status']}, "
+            f"live={str(r['live']).lower()} ready={str(r['ready']).lower()}, "
+            f"epoch {r['syncedEpoch']}/{st['fleetEpoch']} "
+            f"(replica patch epoch {r['patchEpoch']}), "
+            f"inflight {r['inflight']} [{mark}]")
+    return 0
+
+
+def _fleet_drain(args) -> int:
+    import urllib.request
+
+    url = _fleet_router_url(args)
+    body = json.dumps({"replica": args.replica,
+                       "stop": args.stop}).encode()
+    req = urllib.request.Request(
+        f"{url}/fleet/drain", data=body,
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            out = json.loads(resp.read().decode())
+    except Exception as e:  # noqa: BLE001
+        _die(f"drain failed against {url}: {e}")
+    _ok(f"replica {out['replica']} draining"
+        + (" (asked to /stop)" if out.get("stopped") else ""))
     return 0
 
 
@@ -1182,6 +1298,37 @@ def cmd_status(args) -> int:
                     f"heartbeat {h_shown} [{h_mark}]")
     except Exception as e:  # noqa: BLE001 — status must keep printing
         _ok(f"  training runs: unavailable ({e})")
+    try:
+        # ISSUE 17: per-replica serving liveness next to the training
+        # heartbeats — same question ("what is alive?"), serving plane
+        from ..workflow.fleet import read_fleet_state
+
+        state = read_fleet_state()
+        if state:
+            import urllib.request
+
+            url = str(state.get("routerUrl", "")).rstrip("/")
+            try:
+                with urllib.request.urlopen(f"{url}/fleet.json",
+                                            timeout=3) as resp:
+                    st = json.loads(resp.read().decode())
+            except Exception as e:  # noqa: BLE001
+                _ok(f"  serving fleet at {url}: router unreachable ({e})")
+            else:
+                _ok(f"  serving fleet at {url}: epoch {st['fleetEpoch']}, "
+                    f"{len(st['eligible'])}/{len(st['replicas'])} eligible")
+                for r in st["replicas"]:
+                    mark = ("eligible" if r["name"] in st["eligible"]
+                            else "draining" if (r["draining"]
+                                                or r["adminDrained"])
+                            else f"breaker {r['breaker']}")
+                    _ok(f"    replica {r['name']} {r['url']}: "
+                        f"live={str(r['live']).lower()} "
+                        f"ready={str(r['ready']).lower()}, "
+                        f"epoch {r['syncedEpoch']}/{st['fleetEpoch']} "
+                        f"[{mark}]")
+    except Exception as e:  # noqa: BLE001 — status must keep printing
+        _ok(f"  serving fleet: unavailable ({e})")
     if getattr(args, "checkpoint_dir", None):
         try:
             from ..workflow.checkpoint import ShardedTrainCheckpointer
@@ -1696,6 +1843,90 @@ def build_parser() -> argparse.ArgumentParser:
                          "online (pio_shadow_diff_total{tier})")
     sp.add_argument("--shadow-sample", type=float, default=1.0,
                     help="fraction of served queries shadow-mirrored")
+    sp.add_argument("--prewarm-async", action="store_true",
+                    help="bind the port before the executable prewarm "
+                         "and run the prewarm in the background; "
+                         "/health.json reports live-but-not-ready until "
+                         "it completes (fleet replicas start this way "
+                         "so the router can hold hashed traffic)")
+
+    sp = sub.add_parser(
+        "fleet",
+        help="replicated serving fleet: M engine-server replicas "
+             "behind a consistent-hash routing tier with per-replica "
+             "breakers, hedged retry and delta fan-out (ISSUE 17)")
+    f_sub = sp.add_subparsers(dest="fleet_command", required=True)
+    x = f_sub.add_parser(
+        "start",
+        help="spawn N replica processes (pio deploy children sharing "
+             "this storage config) and run the router in the foreground")
+    _add_engine_args(x)
+    x.add_argument("--ip", default="0.0.0.0")
+    x.add_argument("--port", type=int, default=8000,
+                   help="router port — clients keep talking to :8000")
+    x.add_argument("--replicas", type=int, default=2,
+                   help="replica processes to spawn on consecutive "
+                        "ports starting at --base-port")
+    x.add_argument("--base-port", type=int, default=8001)
+    x.add_argument("--replica-urls", default=None,
+                   help="comma-separated engine-server URLs to front "
+                        "INSTEAD of spawning local replicas")
+    x.add_argument("--replica-arg", action="append", default=[],
+                   metavar="ARGS",
+                   help="extra `pio deploy` arguments passed to every "
+                        "spawned replica (repeatable; space-split)")
+    x.add_argument("--probe-interval-s", type=float, default=1.0,
+                   help="per-replica /health.json probe cadence; a dead "
+                        "replica's breaker opens within one interval")
+    x.add_argument("--breaker-reset-s", type=float, default=3.0,
+                   help="open -> half-open probe window per replica")
+    x.add_argument("--deadline-ms", type=float, default=0.0,
+                   help="default end-to-end deadline the router enforces "
+                        "and forwards (decremented) to replicas")
+    x.add_argument("--max-hedges", type=int, default=1,
+                   help="bounded hedged retries of an idempotent query "
+                        "onto sibling replicas (0 disables)")
+    x.add_argument("--spillover-inflight", type=int, default=32,
+                   help="router-side in-flight requests on a hash owner "
+                        "past which a hot key spills to the least-"
+                        "loaded eligible replica")
+    x.add_argument("--journal-max", type=int, default=64,
+                   help="delta fan-out journal entries retained for "
+                        "epoch reconciliation; a replica lagging past "
+                        "the journal takes a full reload instead")
+    x.add_argument("--slo-drain-burn", type=float, default=0.0,
+                   help="drain a replica from hashed traffic while its "
+                        "worst 5m SLO burn rate is at or above this "
+                        "(0 disables the policy)")
+    x.add_argument("--canary-sample", type=int, default=8,
+                   help="recent queries replayed as the shadow-diff "
+                        "canary after the first replica of a rolling "
+                        "reload wave (0 disables the gate)")
+    x.add_argument("--canary-max-mismatch", type=float, default=0.25,
+                   help="mismatch-tier fraction above which the rolling "
+                        "reload wave aborts with the old model still "
+                        "serving on the remaining replicas")
+    x = f_sub.add_parser(
+        "status",
+        help="per-replica liveness, readiness, breaker state and patch-"
+             "epoch lag from the router's /fleet.json")
+    x.add_argument("--router-url", default=None,
+                   help="fleet router base URL (default: the recorded "
+                        "$PIO_HOME/run/fleet.json, else "
+                        "http://127.0.0.1:8000)")
+    x = f_sub.add_parser(
+        "drain",
+        help="take one replica out of hashed rotation (it finishes "
+             "in-flight work; the router stops routing to it)")
+    x.add_argument("--router-url", default=None,
+                   help="fleet router base URL (default: the recorded "
+                        "$PIO_HOME/run/fleet.json, else "
+                        "http://127.0.0.1:8000)")
+    x.add_argument("--replica", required=True,
+                   help="replica name (r0, r1, ...) or URL")
+    x.add_argument("--stop", action="store_true",
+                   help="also ask the replica to /stop (graceful "
+                        "process exit after its own drain)")
 
     sp = sub.add_parser("batchpredict")
     _add_engine_args(sp)
@@ -2009,6 +2240,7 @@ COMMANDS = {
     "eval": cmd_eval,
     "tune": cmd_tune,
     "deploy": cmd_deploy,
+    "fleet": cmd_fleet,
     "batchpredict": cmd_batchpredict,
     "bench": cmd_bench,
     "undeploy": cmd_undeploy,

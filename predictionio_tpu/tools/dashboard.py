@@ -55,6 +55,30 @@ async def handle_slo(request: web.Request) -> web.Response:
     })
 
 
+async def handle_fleet(request: web.Request) -> web.Response:
+    """ISSUE 17: proxy the fleet router's /fleet.json — per-replica
+    breaker state, readiness and patch-epoch lag. Point --engine-url
+    at the ROUTER when serving behind a fleet; against a plain engine
+    server (404) this reports fleet=false instead of erroring, so the
+    panel renders in both topologies."""
+    import aiohttp
+
+    base = request.query.get("url") or request.app[ENGINE_URL_KEY]
+    try:
+        timeout = aiohttp.ClientTimeout(total=5)
+        async with aiohttp.ClientSession(timeout=timeout) as session:
+            async with session.get(base.rstrip("/") + "/fleet.json") as r:
+                if r.status == 404:
+                    return web.json_response(
+                        {"engineUrl": base, "fleet": False})
+                body = await r.json()
+    except Exception as e:  # noqa: BLE001 — report, don't crash the page
+        return web.json_response(
+            {"engineUrl": base, "error": f"fleet router unreachable: {e}"},
+            status=502)
+    return web.json_response({"engineUrl": base, "fleet": True, **body})
+
+
 async def handle_train(request: web.Request) -> web.Response:
     """ISSUE 12: proxy the engine server's train/stream convergence and
     device-ledger blocks — the live answer to "is this run converging
@@ -241,6 +265,7 @@ def create_dashboard_app(
     app.router.add_get("/slo.json", handle_slo)
     app.router.add_get("/train.json", handle_train)
     app.router.add_get("/variants.json", handle_variants)
+    app.router.add_get("/fleet.json", handle_fleet)
     app.router.add_get("/tune.json", handle_tune)
     app.router.add_get(
         "/engine_instances/{instance_id}/evaluator_results.txt", handle_results_txt

@@ -468,6 +468,10 @@ def prepare_deploy(
     names, algos = engine.make_algorithms(engine_params)
     serving = engine.make_serving(engine_params)
 
+    # chaos site: a poisoned/unreachable blob pull (ISSUE 17). Fires
+    # before the fetch so a fallback-mode deploy quarantines this
+    # instance exactly like a corrupt checksum would.
+    FAULTS.fire("replica.blob_pull")
     blob = Storage.get_models().get(instance.id)
     if blob is None:
         raise RuntimeError(f"no model blob for engine instance {instance.id}")

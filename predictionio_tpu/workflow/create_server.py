@@ -346,6 +346,10 @@ class EngineServer:
     #: dashboard view); None until a gated publish arrives
     last_stream_gate: dict | None = None
 
+    #: class-level default so skeleton servers (object.__new__ in
+    #: tests) report ready the way a fully-built server does
+    _prewarming: bool = False
+
     def __init__(
         self,
         engine: Engine,
@@ -384,6 +388,7 @@ class EngineServer:
         shadow_sample: float = 1.0,
         variant_id: str = "default",
         serving_pipeline: str = "pipelined",
+        defer_prewarm: bool = False,
     ):
         self.engine = engine
         self.ctx = ctx or Context(mode="Serving")
@@ -400,19 +405,27 @@ class EngineServer:
         self.deploy_skips: list[dict] = []
         self.serving_pipeline = (str(serving_pipeline).lower()
                                  if serving_pipeline else "pipelined")
+        # ISSUE 17: readiness vs liveness. While True the server is
+        # LIVE (answers queries, compiling on demand) but NOT READY —
+        # /health.json reports ready=false so a fleet router withholds
+        # hashed traffic until the executable prewarm lands, instead of
+        # today's ambiguous 200. Set by defer_prewarm; cleared by
+        # complete_prewarm().
+        self._prewarming = bool(defer_prewarm)
+        prewarm_batch = 0 if defer_prewarm else batch_max
         if fallback:
             inst, result, self.deploy_skips = self._deploy_with_fallback(instance)
             self.deployed = Deployed(
                 inst, result,
                 retriever_mesh=retriever_mesh, retriever_axis=retriever_axis,
-                prewarm_batch=batch_max, retrieval=retrieval,
+                prewarm_batch=prewarm_batch, retrieval=retrieval,
                 serving_pipeline=self.serving_pipeline)
         else:  # explicitly pinned instance: fail loud, never substitute
             self.deployed = Deployed(
                 instance,
                 prepare_deploy(engine, instance, self.ctx, engine_dir=engine_dir),
                 retriever_mesh=retriever_mesh, retriever_axis=retriever_axis,
-                prewarm_batch=batch_max, retrieval=retrieval,
+                prewarm_batch=prewarm_batch, retrieval=retrieval,
                 serving_pipeline=self.serving_pipeline)
         self.feedback_url = feedback_url
         self.access_key = access_key
@@ -823,6 +836,26 @@ class EngineServer:
         log.info("drain complete (served %d request(s) lifetime)",
                  self.request_count)
 
+    @property
+    def prewarming(self) -> bool:
+        return self._prewarming
+
+    def complete_prewarm(self) -> None:
+        """Run the executable prewarm a ``defer_prewarm`` construction
+        skipped, then flip ready. Lets a replica bind its port and
+        answer /health.json (live, not ready) while the AOT compile of
+        the batch lattice runs — the fleet router holds hashed traffic
+        until ``ready`` goes true. Idempotent."""
+        if not self._prewarming:
+            return
+        try:
+            with self._reload_lock:
+                self.deployed.prewarm_batch = self.batch_max
+                self.deployed._prewarm()
+        finally:
+            self._prewarming = False
+            log.info("deferred prewarm complete; server is ready")
+
     def undrain(self) -> None:
         """Re-arm after a drain that did NOT end the process: a failed
         bind tears the app down (running the drain hook) before
@@ -835,7 +868,14 @@ class EngineServer:
     def health(self) -> dict:
         """GET /health.json body: liveness + readiness + why. Load
         balancers key on the HTTP status (503 while draining); humans and
-        autoscalers get the degraded/watchdog/drain detail."""
+        autoscalers get the degraded/watchdog/drain detail.
+
+        ISSUE 17 splits the two semantics cleanly: ``status``/``live``
+        are LIVENESS (the process answers; restart it only when they
+        say so), ``ready`` is ROUTER ELIGIBILITY — false during a
+        deferred startup prewarm AND while draining, so a fleet router
+        neither routes hashed traffic to a cold replica nor to one on
+        its way out."""
         inst = self.deployed.instance
         b = self.batcher
         return {
@@ -843,7 +883,8 @@ class EngineServer:
                        else self._mode if self._mode != "normal" else "ok"),
             "mode": self._mode,
             "live": True,
-            "ready": not self._draining,
+            "ready": not self._draining and not self._prewarming,
+            "prewarming": self._prewarming,
             "variant": self.variant_id,
             "engineInstanceId": inst.id,
             "startTime": self.start_time.isoformat(),
@@ -2021,6 +2062,7 @@ def run_engine_server(
     ip: str = "0.0.0.0",
     port: int = 8000,
     bind_retries: int = 3,
+    prewarm_async: bool = False,
     **kwargs,
 ) -> None:
     """Blocking entry (reference default port 8000, ServerConfig :77-92).
@@ -2028,7 +2070,12 @@ def run_engine_server(
     Before binding, any stale engine server on the port is asked to
     /stop, and a failed bind retries ``bind_retries`` times with 1 s
     backoff before exiting with a diagnostic instead of a raw traceback
-    (reference MasterActor, CreateServer.scala:264-288 + :340-350)."""
+    (reference MasterActor, CreateServer.scala:264-288 + :340-350).
+
+    ``prewarm_async`` (ISSUE 17, fleet replicas): bind the port FIRST
+    and run the executable prewarm in the background — /health.json
+    answers live-but-not-ready until it lands, so a router can track
+    the replica's startup without routing hashed traffic at it."""
     import errno
 
     logging.basicConfig(level=logging.INFO)
@@ -2036,7 +2083,11 @@ def run_engine_server(
     # the whole prepare_deploy duration to release the port, and a
     # foreign occupant is reported without first loading a model
     undeploy_stale("127.0.0.1" if ip in ("0.0.0.0", "::") else ip, port)
-    server = EngineServer(engine, instance, **kwargs)
+    server = EngineServer(engine, instance, defer_prewarm=prewarm_async,
+                          **kwargs)
+    if prewarm_async:
+        threading.Thread(target=server.complete_prewarm,
+                         name="pio-prewarm", daemon=True).start()
     log.info("Engine server (instance %s) starting on %s:%d", instance.id, ip, port)
     for attempt in range(bind_retries + 1):
         try:

@@ -98,6 +98,24 @@ Sites instrumented in this repo:
   staging buffer hostage — the batch must degrade through the
   micro-batcher's watchdog while later dispatches swap to the second
   buffer or a transient one, never wedging the pool)
+- ``fleet.route``            — head of the fleet router's routing
+  decision (``workflow/fleet.FleetRouter.handle_query``; async site;
+  an ``error`` is a routing-tier bug — the router answers 500 and the
+  replicas never see the request)
+- ``fleet.replica_dispatch`` — before every proxied query attempt to a
+  replica (async site; an ``error`` with ``times=1`` kills exactly one
+  dispatch and the bounded hedged retry must answer from a sibling
+  within the request's remaining deadline budget)
+- ``fleet.delta_fanout``     — before each per-replica delta POST in
+  the router's streaming fan-out (async site; an ``error`` makes a
+  replica miss a patch epoch — the probe loop must reconcile it from
+  the journal before it rejoins the eligible set)
+- ``replica.blob_pull``      — head of the model-blob fetch in
+  ``prepare_deploy`` (sync site; an ``error`` is a poisoned or
+  unreachable blob pull — the deploy-with-fallback walk quarantines
+  the instance and deploys the next-newest COMPLETED one, or a pinned
+  deploy fails loud and the replica never reports ready, keeping it
+  out of the router's rotation)
 
 A fault is armed per site with a kind:
 
@@ -153,6 +171,10 @@ SITES: tuple[str, ...] = (
     "stream.publish",
     "tune.trial",
     "pipeline.swap",
+    "fleet.route",
+    "fleet.replica_dispatch",
+    "fleet.delta_fanout",
+    "replica.blob_pull",
 )
 
 #: chaos runs must always be measurable: one counter series per site,

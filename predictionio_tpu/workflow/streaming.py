@@ -225,6 +225,11 @@ class StreamingUpdater:
         self.publish_failures = 0
         self.last_epoch = 0
         self.last_gate: dict | None = None
+        # ISSUE 17: when engine_url is a fleet ROUTER, the publish
+        # response carries per-replica fan-out outcomes; the latest one
+        # is surfaced in stats() so `pio stream` logs show which
+        # replicas took the patch and which will reconcile by epoch
+        self.last_fanout: dict | None = None
 
     # -- event parsing -----------------------------------------------------
     @staticmethod
@@ -417,6 +422,21 @@ class StreamingUpdater:
         self.breaker.success()
         self.last_epoch = int(out.get("epoch", 0))
         _M_EPOCH.set(self.last_epoch)
+        # ISSUE 17: a fleet router answers with per-replica fan-out
+        # outcomes. Partial delivery still commits the cursor — the
+        # router journaled this epoch and reconciles every laggard
+        # before it rejoins hashed traffic — but the laggards are worth
+        # a log line and a stats() surface.
+        fanout = out.get("replicas")
+        if isinstance(fanout, dict):
+            self.last_fanout = fanout
+            lagging = sorted(n for n, v in fanout.items()
+                             if not (isinstance(v, dict) and v.get("ok")))
+            if lagging:
+                log.warning(
+                    "fleet fan-out epoch %d partial: replica(s) %s "
+                    "lagging (router reconciles them from its journal)",
+                    self.last_epoch, ", ".join(lagging))
         trace_event("stream.publish", trace=trace, partition=partition,
                     users=len(patches), epoch=self.last_epoch)
         return True
@@ -525,6 +545,7 @@ class StreamingUpdater:
             "publishFailures": self.publish_failures,
             "patchEpoch": self.last_epoch,
             "lastGate": self.last_gate,
+            "lastFanout": self.last_fanout,
             "breaker": {
                 "state": self.breaker.state,
                 "opens": self.breaker.opens,

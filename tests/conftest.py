@@ -93,6 +93,14 @@ def pytest_configure(config):
         "site — workflow/tuning.py, models/als.py train_als_grid; "
         "test_tuning.py); shares the chaos guard's SIGALRM timeout and "
         "fault cleanup; select with -m tune")
+    config.addinivalue_line(
+        "markers",
+        "fleet: serving-fleet tests (the FleetRouter routing tier — "
+        "consistent-hash routing, per-replica breakers, hedged retry, "
+        "delta fan-out with epoch reconciliation, and the kill-a-"
+        "replica acceptance gate — workflow/fleet.py; test_fleet.py); "
+        "shares the chaos guard's SIGALRM timeout and fault cleanup; "
+        "select with -m fleet")
 
 
 #: Hard per-test budget for chaos tests. Injected hangs are capped at
@@ -113,7 +121,8 @@ def _chaos_guard(request):
             and request.node.get_closest_marker("streaming") is None
             and request.node.get_closest_marker("replay") is None
             and request.node.get_closest_marker("multiengine") is None
-            and request.node.get_closest_marker("tune") is None):
+            and request.node.get_closest_marker("tune") is None
+            and request.node.get_closest_marker("fleet") is None):
         yield
         return
 

@@ -8,7 +8,7 @@ from datetime import datetime, timedelta, timezone
 class ServerThread:
     """Run an aiohttp app on an ephemeral port in a daemon thread."""
 
-    def __init__(self, app_factory):
+    def __init__(self, app_factory, port=0):
         from aiohttp import web
 
         self._loop = asyncio.new_event_loop()
@@ -18,7 +18,9 @@ class ServerThread:
         async def _start():
             runner = web.AppRunner(app_factory())
             await runner.setup()
-            site = web.TCPSite(runner, "127.0.0.1", 0)
+            # port=0 -> ephemeral; a fixed port lets a test "restart" a
+            # replica at the same address (fleet rejoin scenarios)
+            site = web.TCPSite(runner, "127.0.0.1", port)
             await site.start()
             self.port = runner.addresses[0][1]
             self._runner = runner

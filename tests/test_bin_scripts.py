@@ -247,3 +247,56 @@ def test_pio_stream_help_documents_updater_flags(tmp_path):
                  "--follow-name", "--max-records", "--fold-in-solver",
                  "--breaker-threshold", "--breaker-reset-s"):
         assert flag in out.stdout, f"{flag} missing from stream --help"
+
+
+def test_pio_fleet_help_documents_subcommands(tmp_path):
+    """ISSUE 17: the serving fleet is operator surface — `pio fleet
+    --help` must list the lifecycle subcommands the Serving fleet
+    runbook documents."""
+    env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
+    out = subprocess.run([str(REPO / "bin" / "pio"), "fleet", "--help"],
+                         capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    for sub in ("start", "status", "drain"):
+        assert sub in out.stdout, f"{sub} missing from fleet --help"
+
+
+def test_pio_fleet_start_help_documents_router_flags(tmp_path):
+    """ISSUE 17: every routing-tier policy knob — replica topology,
+    probe/breaker cadence, hedging, delta journal, SLO drain and the
+    reload canary gate — must be on `pio fleet start --help`."""
+    env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [str(REPO / "bin" / "pio"), "fleet", "start", "--help"],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    for flag in ("--replicas", "--base-port", "--replica-urls",
+                 "--probe-interval-s", "--breaker-reset-s", "--deadline-ms",
+                 "--max-hedges", "--spillover-inflight", "--journal-max",
+                 "--slo-drain-burn", "--canary-sample",
+                 "--canary-max-mismatch"):
+        assert flag in out.stdout, f"{flag} missing from fleet start --help"
+
+
+def test_pio_fleet_status_and_drain_help(tmp_path):
+    env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [str(REPO / "bin" / "pio"), "fleet", "status", "--help"],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0 and "--router-url" in out.stdout
+    out = subprocess.run(
+        [str(REPO / "bin" / "pio"), "fleet", "drain", "--help"],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    for flag in ("--router-url", "--replica", "--stop"):
+        assert flag in out.stdout, f"{flag} missing from fleet drain --help"
+
+
+def test_pio_deploy_help_documents_prewarm_async(tmp_path):
+    """ISSUE 17 satellite: fleet replicas bind first and prewarm in the
+    background (live-but-not-ready); the flag must be on the surface."""
+    env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
+    out = subprocess.run([str(REPO / "bin" / "pio"), "deploy", "--help"],
+                         capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    assert "--prewarm-async" in out.stdout

@@ -132,3 +132,50 @@ def test_phase_timer_accumulates_and_reports():
     reset_phases(ctx)
     assert ctx.phase_times == []
     assert phase_times_json(ctx) == "[]"
+
+
+def test_fleet_router_hop_joins_the_trace(caplog):
+    """ISSUE 17 satellite: ONE request id stitches the router hop to
+    the replica's serving path — grepping the trace log for the rid
+    must surface both the router's ``fleet.route`` event and the
+    replica's ``serve.ingress`` event (the cross-process trace join an
+    operator does when debugging a fleet-routed query)."""
+    import json as json_mod
+    import logging
+
+    import pytest
+    import requests
+
+    from predictionio_tpu.obs.trace import TRACE_HEADER
+    from predictionio_tpu.workflow.create_server import (
+        EngineServer,
+        create_engine_server_app,
+    )
+    from predictionio_tpu.workflow.fleet import FleetRouter, create_fleet_app
+    from tests.helpers import ServerThread
+    from tests.test_resilience import _trained
+
+    pytest.importorskip("aiohttp")
+    caplog.set_level(logging.INFO, logger="pio.trace")
+
+    engine, inst = _trained()
+    server = EngineServer(engine, inst)
+    replica = ServerThread(lambda: create_engine_server_app(server))
+    router = FleetRouter([replica.url], probe_interval_s=5.0)
+    front = ServerThread(lambda: create_fleet_app(router))
+    rid = "fleet-trace-join-rid"
+    try:
+        r = requests.post(front.url + "/queries.json",
+                          json={"q": 7},
+                          headers={TRACE_HEADER: rid}, timeout=15)
+        assert r.status_code == 200
+        assert r.headers[TRACE_HEADER] == rid
+    finally:
+        front.stop()
+        replica.stop()
+
+    lines = [json_mod.loads(rec.message) for rec in caplog.records
+             if rec.name == "pio.trace"]
+    mine = [ln for ln in lines if ln.get("trace") == rid]
+    events = {ln["evt"] for ln in mine}
+    assert {"fleet.route", "serve.ingress"} <= events, events
