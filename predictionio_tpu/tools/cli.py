@@ -651,20 +651,23 @@ def cmd_deploy(args) -> int:
 
 
 def cmd_fleet(args) -> int:
-    """ISSUE 17: the replicated serving fleet — start M replica
-    processes behind a routing tier, inspect per-replica health, and
-    drain a replica out of rotation."""
+    """ISSUE 17/18: the replicated serving fleet — start M replica
+    processes behind a routing tier (optionally supervised:
+    reap/respawn/quarantine), inspect per-replica health, drain a
+    replica out of rotation, and roll a canary-gated restart wave."""
     return {"start": _fleet_start, "status": _fleet_status,
-            "drain": _fleet_drain}[args.fleet_command](args)
+            "drain": _fleet_drain,
+            "restart": _fleet_restart}[args.fleet_command](args)
 
 
 def _fleet_start(args) -> int:
-    from ..workflow.fleet import (run_fleet_router, spawn_replicas,
-                                  write_fleet_state)
+    from ..workflow.fleet import (fleet_state_path, run_fleet_router,
+                                  spawn_replicas, write_fleet_state)
 
     router_ip = "127.0.0.1" if args.ip in ("0.0.0.0", "::") else args.ip
     router_url = f"http://{router_ip}:{args.port}"
     procs = []
+    extra = []
     if args.replica_urls:
         # front EXISTING engine servers (e.g. on other hosts)
         urls = [u.strip().rstrip("/")
@@ -679,15 +682,62 @@ def _fleet_start(args) -> int:
                                args.base_port, extra_args=tuple(extra))
         urls = [f"http://127.0.0.1:{args.base_port + i}"
                 for i in range(args.replicas)]
-    write_fleet_state(router_url, [
-        {"name": f"r{i}", "url": u,
-         "pid": (procs[i].pid if i < len(procs) else None)}
-        for i, u in enumerate(urls)])
+    started = time.time()
+
+    def _publish_state(sup=None) -> None:
+        active, quarantined = [], []
+        if sup is not None:
+            for rep in sup.replicas:
+                entry = {"name": rep.name, "url": rep.url,
+                         "pid": (rep.proc.pid if rep.proc is not None
+                                 else None),
+                         "startedAt": started}
+                (quarantined if rep.state == "quarantined"
+                 else active).append(entry)
+        else:
+            active = [{"name": f"r{i}", "url": u,
+                       "pid": (procs[i].pid if i < len(procs) else None),
+                       "startedAt": started}
+                      for i, u in enumerate(urls)]
+        write_fleet_state(router_url, active, router_pid=os.getpid(),
+                          router_started_at=started,
+                          quarantined=quarantined)
+
+    supervisor = None
+    if args.supervise:
+        if not procs:
+            _die("--supervise needs locally spawned replicas "
+                 "(it cannot respawn processes behind --replica-urls)")
+        from ..workflow.supervise import FleetSupervisor
+
+        def _respawn_one(rep):
+            return spawn_replicas(args.engine_dir, 1, rep.port,
+                                  extra_args=tuple(extra))[0]
+
+        supervisor = FleetSupervisor(
+            _respawn_one,
+            [{"name": f"r{i}", "port": args.base_port + i, "url": u}
+             for i, u in enumerate(urls)],
+            max_respawns=args.max_respawns,
+            crash_window_s=args.crash_window_s,
+            quarantine_s=args.quarantine_s,
+            state_writer=_publish_state)
+        for i, p in enumerate(procs):
+            supervisor.adopt(f"r{i}", p)
+        supervisor.start()
+    _publish_state(supervisor)
+    state_dir = args.state_dir or str(
+        fleet_state_path().parent / "fleet-router")
     _ok(f"fleet: router on {router_url}, {len(urls)} replica(s): "
         f"{', '.join(urls)}")
+    if supervisor is not None:
+        _ok(f"fleet: supervised (max {args.max_respawns} deaths per "
+            f"{args.crash_window_s:.0f}s window, quarantine "
+            f"{args.quarantine_s:.0f}s)")
     try:
         run_fleet_router(
             urls, ip=args.ip, port=args.port,
+            supervisor=supervisor,
             probe_interval_s=args.probe_interval_s,
             breaker_reset_s=args.breaker_reset_s,
             default_deadline_ms=args.deadline_ms,
@@ -697,10 +747,15 @@ def _fleet_start(args) -> int:
             slo_drain_burn=args.slo_drain_burn,
             canary_sample=args.canary_sample,
             canary_max_mismatch=args.canary_max_mismatch,
+            state_dir=state_dir,
         )
     finally:
+        if supervisor is not None:
+            supervisor.stop()
+            supervisor.terminate_all()
         for p in procs:
-            p.terminate()
+            if p.poll() is None:
+                p.terminate()
         for p in procs:
             try:
                 p.wait(timeout=10)
@@ -723,17 +778,30 @@ def _fleet_router_url(args) -> str:
 def _fleet_status(args) -> int:
     import urllib.request
 
+    if not getattr(args, "router_url", None):
+        # ISSUE 18: a state file whose recorded PIDs are all gone means
+        # there is nothing to probe — say so instead of timing out
+        # against a dead URL
+        from ..workflow.fleet import read_fleet_state
+
+        state = read_fleet_state()
+        if state and state.get("stale"):
+            _die("fleet not running (stale state file): recorded PIDs "
+                 f"are gone (last router {state.get('routerUrl')})")
     url = _fleet_router_url(args)
     try:
         with urllib.request.urlopen(f"{url}/fleet.json", timeout=5) as resp:
             st = json.loads(resp.read().decode())
     except Exception as e:  # noqa: BLE001
         _die(f"fleet router unreachable at {url}: {e}")
+    quarantined = st.get("quarantined") or []
     _ok(f"fleet router {url}: epoch {st['fleetEpoch']}, "
         f"{len(st['eligible'])}/{len(st['replicas'])} replica(s) eligible"
-        f"{' [DRAINING]' if st.get('draining') else ''}")
+        f"{' [DRAINING]' if st.get('draining') else ''}"
+        + (f", {len(quarantined)} quarantined" if quarantined else ""))
     for r in st["replicas"]:
-        mark = ("eligible" if r["name"] in st["eligible"]
+        mark = ("quarantined" if r.get("quarantined")
+                else "eligible" if r["name"] in st["eligible"]
                 else "draining" if r["draining"] or r["adminDrained"]
                 else f"breaker {r['breaker']}" if r["breaker"] != "closed"
                 else "slo-drained" if r["sloDrained"]
@@ -743,6 +811,54 @@ def _fleet_status(args) -> int:
             f"epoch {r['syncedEpoch']}/{st['fleetEpoch']} "
             f"(replica patch epoch {r['patchEpoch']}), "
             f"inflight {r['inflight']} [{mark}]")
+    sup = st.get("supervisor")
+    if sup:
+        for r in sup.get("replicas", []):
+            extras = []
+            if r.get("state") == "backoff":
+                extras.append(f"respawn in {r.get('backoffRemainingS')}s")
+            if r.get("state") == "quarantined":
+                extras.append(
+                    f"cooldown {r.get('quarantineRemainingS')}s")
+            _ok(f"  supervisor {r['name']}: {r['state']}, "
+                f"{r.get('deathsInWindow', 0)} death(s) in window, "
+                f"{r.get('respawns', 0)} respawn(s)"
+                + (f" [{', '.join(extras)}]" if extras else ""))
+    return 0
+
+
+def _fleet_restart(args) -> int:
+    import urllib.request
+
+    url = _fleet_router_url(args)
+    req = urllib.request.Request(
+        f"{url}/fleet/restart?canary={args.canary_sample}",
+        data=b"{}", headers={"Content-Type": "application/json"},
+        method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=args.timeout_s) as resp:
+            out = json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        try:
+            out = json.loads(e.read().decode())
+        except Exception:  # noqa: BLE001
+            _die(f"rolling restart failed against {url}: {e}")
+        _die(f"rolling restart {out.get('outcome', 'failed')}: "
+             f"{out.get('message') or json.dumps(out.get('wave', []))}")
+    except Exception as e:  # noqa: BLE001
+        _die(f"rolling restart failed against {url}: {e}")
+    _ok(f"rolling restart {out['outcome']}: {out.get('restarted', 0)}/"
+        f"{out.get('replicas', 0)} replica(s) restarted")
+    for w in out.get("wave", []):
+        _ok(f"  {w['replica']}: "
+            + (f"restarted in {w.get('restartS')}s" if w.get("ok")
+               else f"FAILED ({w.get('error')})"))
+    canary = out.get("canary")
+    if canary:
+        _ok(f"  canary: {canary.get('sampled')} sampled, mismatch "
+            f"fraction {canary.get('mismatchFraction')} "
+            f"(fresh {canary.get('fresh')} vs baseline "
+            f"{canary.get('baseline')})")
     return 0
 
 
@@ -1304,7 +1420,10 @@ def cmd_status(args) -> int:
         from ..workflow.fleet import read_fleet_state
 
         state = read_fleet_state()
-        if state:
+        if state and state.get("stale"):
+            _ok("  serving fleet: not running (stale state file — "
+                "recorded PIDs are gone)")
+        elif state:
             import urllib.request
 
             url = str(state.get("routerUrl", "")).rstrip("/")
@@ -1318,7 +1437,8 @@ def cmd_status(args) -> int:
                 _ok(f"  serving fleet at {url}: epoch {st['fleetEpoch']}, "
                     f"{len(st['eligible'])}/{len(st['replicas'])} eligible")
                 for r in st["replicas"]:
-                    mark = ("eligible" if r["name"] in st["eligible"]
+                    mark = ("quarantined" if r.get("quarantined")
+                            else "eligible" if r["name"] in st["eligible"]
                             else "draining" if (r["draining"]
                                                 or r["adminDrained"])
                             else f"breaker {r['breaker']}")
@@ -1906,6 +2026,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="mismatch-tier fraction above which the rolling "
                         "reload wave aborts with the old model still "
                         "serving on the remaining replicas")
+    x.add_argument("--supervise", action="store_true",
+                   help="own the replica processes: reap exits, respawn "
+                        "a crashed replica on its original port with "
+                        "jittered exponential backoff, quarantine a "
+                        "crash-looping one (ISSUE 18)")
+    x.add_argument("--max-respawns", type=int, default=5,
+                   help="deaths inside --crash-window-s that flip a "
+                        "replica from respawn-with-backoff to "
+                        "quarantined")
+    x.add_argument("--crash-window-s", type=float, default=60.0,
+                   help="sliding window for crash-loop detection")
+    x.add_argument("--quarantine-s", type=float, default=300.0,
+                   help="cooldown before a quarantined replica is "
+                        "retried")
+    x.add_argument("--state-dir", default=None,
+                   help="durable router state (fleet epoch marker + "
+                        "delta journal); default "
+                        "$PIO_HOME/run/fleet-router — a restarted "
+                        "router resumes at the durable epoch floor")
     x = f_sub.add_parser(
         "status",
         help="per-replica liveness, readiness, breaker state and patch-"
@@ -1927,6 +2066,21 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--stop", action="store_true",
                    help="also ask the replica to /stop (graceful "
                         "process exit after its own drain)")
+    x = f_sub.add_parser(
+        "restart",
+        help="rolling restart wave: drain -> restart -> re-ready one "
+             "replica at a time, gated by the shadow-diff canary after "
+             "the first (requires a --supervise router)")
+    x.add_argument("--router-url", default=None,
+                   help="fleet router base URL (default: the recorded "
+                        "$PIO_HOME/run/fleet.json, else "
+                        "http://127.0.0.1:8000)")
+    x.add_argument("--canary-sample", type=int, default=8,
+                   help="recent queries replayed as the shadow-diff "
+                        "canary after the first restarted replica "
+                        "(0 disables the gate)")
+    x.add_argument("--timeout-s", type=float, default=600.0,
+                   help="client-side wait for the whole wave")
 
     sp = sub.add_parser("batchpredict")
     _add_engine_args(sp)

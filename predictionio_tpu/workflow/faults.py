@@ -116,6 +116,15 @@ Sites instrumented in this repo:
   the instance and deploys the next-newest COMPLETED one, or a pinned
   deploy fails loud and the replica never reports ready, keeping it
   out of the router's rotation)
+- ``supervisor.respawn``     — in ``workflow/supervise.FleetSupervisor``
+  right before a crashed replica's respawn ``Popen`` (sync site; an
+  ``error`` is a failed exec — the attempt counts against the crash
+  window and the supervisor must re-enter backoff, not busy-loop)
+- ``router.state_write``     — inside the atomic tmp+fsync+rename
+  state write (``workflow/fleet._atomic_write_json``), after the tmp
+  file is durable but before the rename publishes it (sync site; an
+  ``error`` is a kill mid-write — the previous ``fleet.json`` /
+  ``epoch.json`` must survive intact and parseable)
 
 A fault is armed per site with a kind:
 
@@ -175,6 +184,8 @@ SITES: tuple[str, ...] = (
     "fleet.replica_dispatch",
     "fleet.delta_fanout",
     "replica.blob_pull",
+    "supervisor.respawn",
+    "router.state_write",
 )
 
 #: chaos runs must always be measurable: one counter series per site,

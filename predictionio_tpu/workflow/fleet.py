@@ -55,20 +55,44 @@ health vocabulary instead of treating every 200 as equal:
   equivalent (and optionally asks the replica to ``/stop``, which the
   replica honors with its own graceful drain).
 
+Self-healing (ISSUE 18) adds two things on top:
+
+- **Durable router state** — with ``state_dir`` set, the fleet epoch
+  and the delta journal survive a router crash: every accepted delta
+  is appended (CRC-framed, fsync'd — the ``storage/journal.py``
+  segment writer) and the epoch marker is published by
+  tmp+fsync+rename *before* the in-memory epoch bumps. A restarted
+  router resumes at the durable epoch floor and bridges lagging
+  replicas by journal replay instead of forcing full ``/reload``
+  resyncs; a replica reporting a patch epoch *ahead* of a
+  freshly-restarted router is recognized as router amnesia (state dir
+  lost) — the router adopts the higher floor and counts
+  ``pio_fleet_router_amnesia_total`` — never as replica corruption.
+- **Quarantine** — ``workflow/supervise.FleetSupervisor`` owns the
+  replica processes (reap, backoff respawn, crash-loop detection) and
+  reports a crash-looping replica here via ``set_quarantined``; a
+  quarantined replica leaves the eligible set so rendezvous traffic
+  redistributes, until the supervisor's cooldown retry succeeds.
+  ``GET /fleet/restart`` delegates a rolling, canary-gated restart
+  wave to the attached supervisor.
+
 Chaos sites (``workflow/faults.py`` harness): ``fleet.route`` at the
 head of the routing decision, ``fleet.replica_dispatch`` before every
 proxied query attempt (arm an error to prove the hedge path),
 ``fleet.delta_fanout`` before every per-replica delta POST (a lagging
-replica must reconcile by epoch, never serve stale factors). The
-replica-side ``replica.blob_pull`` site lives at the head of
-``prepare_deploy``'s blob fetch (core_workflow.py) — a poisoned pull
-either falls back to an older COMPLETED instance or keeps the replica
-not-ready, and the router keeps it out of rotation either way.
+replica must reconcile by epoch, never serve stale factors),
+``router.state_write`` inside the atomic state write (kill-mid-write:
+the previous file must survive). The replica-side
+``replica.blob_pull`` site lives at the head of ``prepare_deploy``'s
+blob fetch (core_workflow.py) — a poisoned pull either falls back to
+an older COMPLETED instance or keeps the replica not-ready, and the
+router keeps it out of rotation either way.
 """
 
 from __future__ import annotations
 
 import asyncio
+import atexit
 import hashlib
 import json
 import logging
@@ -87,13 +111,15 @@ from ..obs.breaker import breaker_set
 from ..obs.metrics import METRICS
 from ..obs.replay import PROVENANCE_HEADER, diff_tier
 from ..obs.trace import TRACE_HEADER, ensure_request_id, trace_event
+from ..storage.journal import EventJournal, JournalFull, iter_journal_records
 from .faults import FAULTS
 from .variants import VARIANT_HEADER, entity_key
 
 __all__ = [
     "DEADLINE_HEADER", "FLEET_REPLICA_HEADER", "Replica", "FleetRouter",
-    "create_fleet_app", "run_fleet_router", "spawn_replicas",
-    "fleet_state_path", "write_fleet_state", "read_fleet_state",
+    "RouterStateStore", "create_fleet_app", "run_fleet_router",
+    "spawn_replicas", "reap_replicas", "fleet_state_path",
+    "write_fleet_state", "read_fleet_state",
 ]
 
 log = logging.getLogger(__name__)
@@ -149,6 +175,14 @@ _M_RECONCILE = METRICS.counter(
     "entries re-sent in order; full_reload = journal could not bridge "
     "the gap, replica reloaded the latest blob then replayed)",
     labelnames=("replica", "kind"))
+_M_AMNESIA = METRICS.counter(
+    "pio_fleet_router_amnesia_total",
+    "a replica reported a patch epoch AHEAD of a freshly-restarted "
+    "router (durable state lost) — the router adopts the higher floor "
+    "instead of treating the replica as corrupt")
+_M_EPOCH_FLOOR = METRICS.gauge(
+    "pio_fleet_epoch_floor",
+    "durable fleet epoch recovered from the state dir at router start")
 
 
 def _rendezvous(key: str, name: str) -> float:
@@ -174,6 +208,7 @@ class Replica:
     draining: bool = False
     admin_drained: bool = False      # POST /fleet/drain
     slo_drained: bool = False        # burn-rate policy
+    quarantined: bool = False        # supervisor crash-loop verdict
     synced_epoch: int = 0            # last fleet epoch applied (-1 = resync)
     reported_epoch: int = 0          # replica's OWN patch epoch, last seen
     start_time: str | None = None    # replica startTime — restart detector
@@ -195,6 +230,7 @@ class Replica:
             "draining": self.draining,
             "adminDrained": self.admin_drained,
             "sloDrained": self.slo_drained,
+            "quarantined": self.quarantined,
             "sloBurn": round(self.slo_burn, 4),
             "syncedEpoch": self.synced_epoch,
             "patchEpoch": self.reported_epoch,
@@ -204,6 +240,103 @@ class Replica:
             "lastError": self.last_error,
             "pid": self.pid,
         }
+
+
+def _atomic_write_json(path: Path, obj: dict) -> None:
+    """Crash-safe JSON publish: write a sibling tmp file, fsync it,
+    then ``os.replace`` over the target — a kill at ANY instant leaves
+    either the previous complete file or the new complete file, never
+    a torn one. The ``router.state_write`` chaos site fires in the
+    widest kill window (tmp durable, rename not yet done); an armed
+    error must leave the previous file intact."""
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_name(path.name + ".tmp")
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(json.dumps(obj, indent=2))
+        f.flush()
+        os.fsync(f.fileno())
+    try:
+        FAULTS.fire("router.state_write")
+    except BaseException:
+        try:
+            tmp.unlink()
+        except OSError:
+            pass
+        raise
+    os.replace(tmp, path)
+
+
+class RouterStateStore:
+    """Durable fleet-router state under one directory (ISSUE 18):
+
+    - ``epoch.json`` — the fleet-epoch marker, published atomically
+      (tmp+fsync+rename) so a crash can never tear it;
+    - ``delta-journal/`` — every accepted delta body as a CRC-framed
+      record (``storage/journal.py`` segment writer, ``fsync="always"``
+      — a delta is only acked after it is durable), each payload an
+      8-byte little-endian fleet epoch followed by the raw JSON body.
+
+    ``load()`` trusts whichever source is further ahead: the journal's
+    last record wins over a marker that lost the race with a crash
+    (the marker is written after the journal append)."""
+
+    def __init__(self, directory: str | os.PathLike, *,
+                 max_bytes: int = 16 * 1024 * 1024):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self._marker = self.dir / "epoch.json"
+        seg = max(64 * 1024, int(max_bytes) // 16)
+        self._journal = EventJournal(
+            self.dir / "delta-journal", fsync="always",
+            max_bytes=max(seg + 1, int(max_bytes)), segment_max_bytes=seg)
+
+    def load(self) -> tuple[int, list[tuple[int, bytes]]]:
+        """Durable (epoch floor, [(epoch, raw delta), ...]) oldest-first."""
+        epoch = 0
+        try:
+            epoch = int(json.loads(self._marker.read_text())
+                        .get("epoch", 0) or 0)
+        except (OSError, ValueError, TypeError, AttributeError):
+            epoch = 0
+        entries: list[tuple[int, bytes]] = []
+        for payload in iter_journal_records(self.dir / "delta-journal"):
+            if len(payload) < 8:
+                continue
+            entries.append((int.from_bytes(payload[:8], "little"),
+                            payload[8:]))
+        if entries:
+            epoch = max(epoch, entries[-1][0])
+        return epoch, entries
+
+    def append(self, epoch: int, raw: bytes) -> None:
+        """Durably append one delta, then publish the epoch marker.
+        Drop-oldest on ``JournalFull`` (same cursor-advance GC as
+        ``obs/capture.CaptureRing``): old deltas past the retention
+        window force laggards to a full reload anyway."""
+        payload = epoch.to_bytes(8, "little") + raw
+        for _ in range(64):
+            try:
+                self._journal.append(payload)
+                break
+            except JournalFull:
+                recs, pos = self._journal.peek_batch(256)
+                if not recs:
+                    raise
+                before = self._journal.size_bytes()
+                self._journal.advance(pos)
+                if self._journal.size_bytes() >= before:
+                    raise
+        self.write_epoch(epoch)
+
+    def write_epoch(self, epoch: int) -> None:
+        _atomic_write_json(self._marker, {"epoch": int(epoch),
+                                          "ts": time.time()})
+
+    def close(self) -> None:
+        try:
+            self._journal.close()
+        except Exception:  # noqa: BLE001 — closing must never raise
+            log.exception("router state journal close failed")
 
 
 ROUTER_KEY = web.AppKey("fleet_router", object)
@@ -237,6 +370,8 @@ class FleetRouter:
         canary_sample: int = 8,
         canary_max_mismatch: float = 0.25,
         recent_ring: int = 64,
+        state_dir: str | os.PathLike | None = None,
+        state_max_bytes: int = 16 * 1024 * 1024,
     ):
         if not replica_urls:
             raise ValueError("a fleet needs at least one replica URL")
@@ -265,9 +400,29 @@ class FleetRouter:
         self._recent: deque[dict] = deque(maxlen=max(1, recent_ring))
         self._session: aiohttp.ClientSession | None = None
         self._probe_task: asyncio.Task | None = None
+        self._loop: asyncio.AbstractEventLoop | None = None
         self._draining = False
         self._inflight = 0
         self.start_time = time.time()
+        #: attached by `pio fleet start --supervise` — the rolling
+        #: restart endpoint delegates here
+        self.supervisor = None
+        self._store: RouterStateStore | None = None
+        if state_dir is not None:
+            # durable state: resume at the epoch floor that survived
+            # the last router process, with the retained delta journal
+            # rehydrated as the replay source for lagging replicas
+            self._store = RouterStateStore(state_dir,
+                                           max_bytes=state_max_bytes)
+            floor, entries = self._store.load()
+            self.fleet_epoch = floor
+            for entry in entries:
+                self._journal.append(entry)
+            _M_EPOCH.set(floor)
+            _M_EPOCH_FLOOR.set(floor)
+            if floor:
+                log.info("fleet router resumed at durable epoch %d "
+                         "(%d journal entries)", floor, len(entries))
         for r in self.replicas:
             breaker_set(f"fleet.{r.name}", "closed")
             _M_READY.set(0, replica=r.name)
@@ -278,6 +433,7 @@ class FleetRouter:
         """Create the client session, run ONE full probe round (so the
         eligible set is known before the first query), start the loop."""
         self._session = aiohttp.ClientSession()
+        self._loop = asyncio.get_running_loop()
         await self._probe_all()
         self._probe_task = asyncio.create_task(self._probe_loop())
 
@@ -296,6 +452,8 @@ class FleetRouter:
         if self._session is not None:
             await self._session.close()
             self._session = None
+        if self._store is not None:
+            self._store.close()
 
     # -- health / breaker --------------------------------------------------
     def _set_breaker(self, r: Replica, state: str) -> None:
@@ -327,7 +485,7 @@ class FleetRouter:
     def _eligible_one(self, r: Replica) -> bool:
         return (r.breaker == "closed" and r.live and r.ready
                 and not r.draining and not r.admin_drained
-                and not r.slo_drained
+                and not r.slo_drained and not r.quarantined
                 and r.synced_epoch >= self.fleet_epoch)
 
     def _eligible(self) -> list[Replica]:
@@ -377,6 +535,7 @@ class FleetRouter:
         self._record_success(r)
         reported = int((body.get("model") or {}).get("patchEpoch", 0) or 0)
         started = body.get("startTime")
+        first_sight = r.start_time is None
         restarted = (r.start_time is not None and started != r.start_time)
         if restarted or reported < r.reported_epoch:
             # a fresh process (or one that lost its patch table) looks
@@ -384,6 +543,33 @@ class FleetRouter:
             log.info("replica %s restarted (epoch %d -> %d); resyncing",
                      r.name, r.reported_epoch, reported)
             r.synced_epoch = -1
+        elif first_sight and reported > 0:
+            # first contact by THIS router process with a replica that
+            # already holds patches. Deltas reach replicas only through
+            # a router, so the replica's own patch epoch IS its fleet
+            # sync point: adopt it instead of forcing a resync. A
+            # replica AHEAD of the router's epoch means the router lost
+            # its durable state (amnesia) — adopt the higher floor and
+            # count it; it is never replica corruption.
+            if reported > self.fleet_epoch:
+                log.warning(
+                    "replica %s reports epoch %d ahead of router epoch "
+                    "%d: router amnesia — adopting the replica's floor",
+                    r.name, reported, self.fleet_epoch)
+                _M_AMNESIA.inc()
+                self.fleet_epoch = reported
+                _M_EPOCH.set(reported)
+                trace_event("fleet.amnesia", replica=r.name,
+                            epoch=reported)
+                if self._store is not None:
+                    try:
+                        await asyncio.to_thread(self._store.write_epoch,
+                                                reported)
+                    except Exception:  # noqa: BLE001 — floor is advisory
+                        log.exception("epoch marker write failed")
+            r.synced_epoch = max(r.synced_epoch,
+                                 min(reported, self.fleet_epoch))
+            _M_REPLICA_EPOCH.set(r.synced_epoch, replica=r.name)
         r.start_time = started
         r.reported_epoch = reported
         if self.slo_drain_burn > 0:
@@ -625,8 +811,21 @@ class FleetRouter:
             return web.json_response(
                 {"message": 'Body must be {"users": {user_id: [factor]}}.'},
                 status=400, headers=headers)
-        self.fleet_epoch += 1
-        epoch = self.fleet_epoch
+        epoch = self.fleet_epoch + 1
+        if self._store is not None:
+            # durability BEFORE visibility: the delta is journaled and
+            # the epoch marker published before the in-memory epoch
+            # bumps, so a router killed at any instant either never
+            # acked this epoch or can replay it after restart
+            try:
+                await asyncio.to_thread(self._store.append, epoch, raw)
+            except Exception as e:  # noqa: BLE001 — updater must retry
+                log.exception("durable delta append failed at epoch %d",
+                              epoch)
+                return web.json_response(
+                    {"message": f"router state write failed: {e}"},
+                    status=500, headers=headers)
+        self.fleet_epoch = epoch
         _M_EPOCH.set(epoch)
         self._journal.append((epoch, raw))
         results: dict[str, dict] = {}
@@ -799,18 +998,98 @@ class FleetRouter:
         self._mark_ready(r, r.ready)
         return web.json_response({"message": "undrained", "replica": r.name})
 
+    # -- supervisor integration (ISSUE 18) ---------------------------------
+    def set_quarantined(self, token: str, active: bool) -> bool:
+        """Supervisor verdict on a crash-looping replica. Plain field
+        mutation — safe to call from the supervisor's thread; the next
+        routing decision sees the new eligible set."""
+        r = self._find(token)
+        if r is None:
+            return False
+        if r.quarantined != active:
+            r.quarantined = active
+            log.warning("replica %s %s", r.name,
+                        "QUARANTINED (crash loop)" if active
+                        else "released from quarantine")
+            trace_event("fleet.quarantine", replica=r.name, active=active)
+        self._mark_ready(r, r.ready)
+        return True
+
+    def set_admin_drained(self, token: str, active: bool) -> bool:
+        """Thread-safe drain toggle for the supervisor's rolling wave
+        (the HTTP handlers above are the loop-side equivalent)."""
+        r = self._find(token)
+        if r is None:
+            return False
+        r.admin_drained = active
+        self._mark_ready(r, r.ready)
+        return True
+
+    def canary_from_thread(self, fresh: str, baseline: str,
+                           sample: int, timeout_s: float = 60.0) -> dict:
+        """Run the shadow-diff canary on the router's event loop from a
+        foreign (supervisor) thread."""
+        fr, br = self._find(fresh), self._find(baseline)
+        if fr is None or br is None or self._loop is None:
+            return {"sampled": 0, "tiers": {}, "mismatchFraction": 0.0,
+                    "baseline": baseline, "fresh": fresh}
+        fut = asyncio.run_coroutine_threadsafe(
+            self._canary(fr, br, sample), self._loop)
+        return fut.result(timeout=timeout_s)
+
+    async def handle_fleet_quarantine(self,
+                                      request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            body = {}
+        token = str(body.get("replica", ""))
+        active = bool(body.get("active", True))
+        if not self.set_quarantined(token, active):
+            return web.json_response(
+                {"message": f"unknown replica {body.get('replica')!r}"},
+                status=404)
+        return web.json_response(
+            {"message": "quarantined" if active else "released",
+             "replica": token})
+
+    async def handle_fleet_restart(self,
+                                   request: web.Request) -> web.Response:
+        """Rolling, canary-gated restart wave — delegated to the
+        attached FleetSupervisor (`pio fleet start --supervise`)."""
+        sup = self.supervisor
+        if sup is None:
+            return web.json_response(
+                {"message": "no supervisor attached to this router "
+                            "(start the fleet with --supervise)"},
+                status=409)
+        try:
+            sample = int(request.query.get("canary", self.canary_sample))
+        except ValueError:
+            sample = self.canary_sample
+        report = await asyncio.to_thread(sup.rolling_restart,
+                                         canary_sample=sample)
+        return web.json_response(
+            report, status=200 if report.get("outcome") == "ok" else 409)
+
     # -- status ------------------------------------------------------------
     def status(self) -> dict:
-        return {
+        out = {
             "fleetEpoch": self.fleet_epoch,
             "journal": {"entries": len(self._journal),
                         "floorEpoch": (self._journal[0][0]
                                        if self._journal else None)},
+            "durable": self._store is not None,
             "draining": self._draining,
             "eligible": [r.name for r in self._eligible()],
+            "quarantined": [r.name for r in self.replicas
+                            if r.quarantined],
             "replicas": [r.snapshot(self.fleet_epoch)
                          for r in self.replicas],
         }
+        if self.supervisor is not None:
+            out["supervisor"] = self.supervisor.status()
+        return out
 
     async def handle_fleet_json(self, request: web.Request) -> web.Response:
         return web.json_response(self.status())
@@ -865,6 +1144,8 @@ def create_fleet_app(router: FleetRouter) -> web.Application:
     app.router.add_post("/reload/delta", router.handle_reload_delta)
     app.router.add_post("/fleet/drain", router.handle_fleet_drain)
     app.router.add_post("/fleet/undrain", router.handle_fleet_undrain)
+    app.router.add_post("/fleet/quarantine", router.handle_fleet_quarantine)
+    app.router.add_post("/fleet/restart", router.handle_fleet_restart)
     app.router.add_get("/stop", router.handle_stop)
 
     async def _start(app):
@@ -879,10 +1160,13 @@ def create_fleet_app(router: FleetRouter) -> web.Application:
 
 
 def run_fleet_router(replica_urls: list[str], ip: str = "0.0.0.0",
-                     port: int = 8000, **kwargs) -> None:
+                     port: int = 8000, supervisor=None, **kwargs) -> None:
     """Blocking entry for the router process (`pio fleet start`)."""
     logging.basicConfig(level=logging.INFO)
     router = FleetRouter(replica_urls, **kwargs)
+    if supervisor is not None:
+        router.supervisor = supervisor
+        supervisor.router = router
     log.info("Fleet router starting on %s:%d over %d replica(s)",
              ip, port, len(router.replicas))
     web.run_app(create_fleet_app(router), host=ip, port=port, print=None)
@@ -898,21 +1182,112 @@ def fleet_state_path() -> Path:
     return home / "run" / "fleet.json"
 
 
-def write_fleet_state(router_url: str, replicas: list[dict]) -> Path:
+def write_fleet_state(router_url: str, replicas: list[dict], *,
+                      router_pid: int | None = None,
+                      router_started_at: float | None = None,
+                      quarantined: list[dict] | None = None) -> Path:
+    """Atomically publish the fleet pidfile (tmp+fsync+rename — a kill
+    mid-write leaves the previous state intact). ``replicas`` is the
+    ACTIVE set; quarantined replicas move to the ``quarantined`` list
+    so rendezvous consumers of the file never route to them."""
     p = fleet_state_path()
-    p.parent.mkdir(parents=True, exist_ok=True)
-    p.write_text(json.dumps({"routerUrl": router_url,
-                             "replicas": replicas,
-                             "ts": time.time()}, indent=2))
+    _atomic_write_json(p, {
+        "routerUrl": router_url,
+        "routerPid": router_pid,
+        "routerStartedAt": router_started_at,
+        "replicas": replicas,
+        "quarantined": quarantined or [],
+        "ts": time.time(),
+    })
     return p
 
 
+def _pid_alive(pid) -> bool:
+    try:
+        pid = int(pid)
+    except (TypeError, ValueError):
+        return False
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True  # exists, owned by someone else
+    except OSError:
+        return False
+    return True
+
+
 def read_fleet_state() -> dict | None:
+    """Parse the fleet pidfile; None on missing/truncated/garbage —
+    corruption is 'no fleet', never a traceback. When the file records
+    PIDs and none of them is still alive, the returned dict carries
+    ``stale: True`` so callers report 'fleet not running' instead of
+    probing a dead URL."""
     p = fleet_state_path()
     try:
-        return json.loads(p.read_text())
+        state = json.loads(p.read_text())
     except (OSError, ValueError):
         return None
+    if not isinstance(state, dict):
+        return None
+    pids = [state.get("routerPid")]
+    for r in state.get("replicas") or []:
+        if isinstance(r, dict):
+            pids.append(r.get("pid"))
+    pids = [q for q in pids if q]
+    state["stale"] = bool(pids) and not any(_pid_alive(q) for q in pids)
+    return state
+
+
+#: every brood ever spawned by this process — the atexit sweep
+#: terminates whatever is still running so a failed `pio fleet start`
+#: (or a crashed supervisor) never strands orphan deploy children
+_BROODS: list[list[subprocess.Popen]] = []
+_BROOD_ATEXIT = [False]
+
+
+def _terminate_broods() -> None:
+    for procs in _BROODS:
+        for proc in procs:
+            if proc.poll() is None:
+                try:
+                    proc.terminate()
+                except OSError:
+                    pass
+    deadline = time.monotonic() + 5.0
+    for procs in _BROODS:
+        for proc in procs:
+            if proc.poll() is None:
+                try:
+                    proc.wait(timeout=max(0.1,
+                                          deadline - time.monotonic()))
+                except (subprocess.TimeoutExpired, OSError):
+                    try:
+                        proc.kill()
+                        proc.wait(timeout=1.0)
+                    except (subprocess.TimeoutExpired, OSError):
+                        pass
+
+
+def reap_replicas(procs: list[subprocess.Popen]) -> list[tuple[int, int]]:
+    """Poll-reap exited replica children (no zombies). Returns
+    [(port, returncode)] for the newly exited; a nonzero exit is
+    logged with the replica's port so a crashing deploy child is
+    visible instead of silently absent."""
+    exited: list[tuple[int, int]] = []
+    for proc in procs:
+        rc = proc.poll()
+        if rc is None:
+            continue
+        port = getattr(proc, "pio_port", -1)
+        exited.append((port, rc))
+        if rc != 0:
+            log.warning("replica child pid=%d port=%s exited rc=%d",
+                        proc.pid, port, rc)
+    return exited
 
 
 def spawn_replicas(engine_dir: str, n: int, base_port: int,
@@ -927,7 +1302,11 @@ def spawn_replicas(engine_dir: str, n: int, base_port: int,
     sha256-checked ``prepare_deploy`` path. ``--prewarm-async`` makes
     the replica bind fast and report live-but-not-ready until its
     executable prewarm completes — the router holds hashed traffic
-    until then."""
+    until then.
+
+    Every spawned brood is registered with an atexit sweep that
+    terminates still-running children on interpreter exit; each proc
+    carries its port as ``proc.pio_port`` for ``reap_replicas``."""
     procs: list[subprocess.Popen] = []
     child_env = dict(os.environ if env is None else env)
     for i in range(n):
@@ -935,5 +1314,11 @@ def spawn_replicas(engine_dir: str, n: int, base_port: int,
                "deploy", "--engine-dir", engine_dir,
                "--ip", ip, "--port", str(base_port + i),
                "--prewarm-async", *extra_args]
-        procs.append(subprocess.Popen(cmd, env=child_env))
+        proc = subprocess.Popen(cmd, env=child_env)
+        proc.pio_port = base_port + i
+        procs.append(proc)
+    _BROODS.append(procs)
+    if not _BROOD_ATEXIT[0]:
+        atexit.register(_terminate_broods)
+        _BROOD_ATEXIT[0] = True
     return procs

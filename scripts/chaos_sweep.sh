@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Chaos sweep: every fault-injection-marked suite in one command — the
+# operator-facing "prove the recovery paths still hold" button the
+# Failure modes runbook (docs/operations.md) points at. Each marker
+# shares the conftest SIGALRM chaos guard, so an injected hang can
+# never wedge the sweep.
+#
+#   scripts/chaos_sweep.sh            # the full sweep
+#   scripts/chaos_sweep.sh -k fleet   # extra pytest args pass through
+set -euo pipefail
+DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+export PYTHONPATH="${DIR}${PYTHONPATH:+:$PYTHONPATH}"
+
+MARKERS="chaos or train_chaos or streaming or replay or multiengine \
+or tune or fleet or selfheal or ingest or overload"
+
+exec env JAX_PLATFORMS=cpu "${PIO_PYTHON:-python3}" -m pytest \
+    "${DIR}/tests" -q -m "${MARKERS}" \
+    -p no:cacheprovider -p no:xdist -p no:randomly "$@"

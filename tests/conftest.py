@@ -101,6 +101,15 @@ def pytest_configure(config):
         "replica acceptance gate — workflow/fleet.py; test_fleet.py); "
         "shares the chaos guard's SIGALRM timeout and fault cleanup; "
         "select with -m fleet")
+    config.addinivalue_line(
+        "markers",
+        "selfheal: fleet self-healing tests (the FleetSupervisor "
+        "reap/respawn/quarantine lifecycle, durable router state with "
+        "journal-replay recovery, crash-safe fleet.json, and the "
+        "supervisor.respawn / router.state_write chaos sites — "
+        "workflow/supervise.py, workflow/fleet.py; test_selfheal.py); "
+        "shares the chaos guard's SIGALRM timeout and fault cleanup; "
+        "select with -m selfheal")
 
 
 #: Hard per-test budget for chaos tests. Injected hangs are capped at
@@ -122,7 +131,8 @@ def _chaos_guard(request):
             and request.node.get_closest_marker("replay") is None
             and request.node.get_closest_marker("multiengine") is None
             and request.node.get_closest_marker("tune") is None
-            and request.node.get_closest_marker("fleet") is None):
+            and request.node.get_closest_marker("fleet") is None
+            and request.node.get_closest_marker("selfheal") is None):
         yield
         return
 

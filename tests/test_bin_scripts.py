@@ -257,7 +257,7 @@ def test_pio_fleet_help_documents_subcommands(tmp_path):
     out = subprocess.run([str(REPO / "bin" / "pio"), "fleet", "--help"],
                          capture_output=True, text=True, env=env, timeout=60)
     assert out.returncode == 0
-    for sub in ("start", "status", "drain"):
+    for sub in ("start", "status", "drain", "restart"):
         assert sub in out.stdout, f"{sub} missing from fleet --help"
 
 
@@ -274,8 +274,23 @@ def test_pio_fleet_start_help_documents_router_flags(tmp_path):
                  "--probe-interval-s", "--breaker-reset-s", "--deadline-ms",
                  "--max-hedges", "--spillover-inflight", "--journal-max",
                  "--slo-drain-burn", "--canary-sample",
-                 "--canary-max-mismatch"):
+                 "--canary-max-mismatch",
+                 # ISSUE 18: the self-healing knobs
+                 "--supervise", "--max-respawns", "--crash-window-s",
+                 "--quarantine-s", "--state-dir"):
         assert flag in out.stdout, f"{flag} missing from fleet start --help"
+
+
+def test_pio_fleet_restart_help_documents_wave_flags(tmp_path):
+    """ISSUE 18: the rolling, canary-gated restart wave is operator
+    surface — its knobs must be on `pio fleet restart --help`."""
+    env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [str(REPO / "bin" / "pio"), "fleet", "restart", "--help"],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    for flag in ("--router-url", "--canary-sample", "--timeout-s"):
+        assert flag in out.stdout, f"{flag} missing from fleet restart --help"
 
 
 def test_pio_fleet_status_and_drain_help(tmp_path):
