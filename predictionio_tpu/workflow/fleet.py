@@ -99,6 +99,8 @@ import logging
 import os
 import subprocess
 import sys
+import tempfile
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass
@@ -243,27 +245,32 @@ class Replica:
 
 
 def _atomic_write_json(path: Path, obj: dict) -> None:
-    """Crash-safe JSON publish: write a sibling tmp file, fsync it,
-    then ``os.replace`` over the target — a kill at ANY instant leaves
-    either the previous complete file or the new complete file, never
-    a torn one. The ``router.state_write`` chaos site fires in the
-    widest kill window (tmp durable, rename not yet done); an armed
-    error must leave the previous file intact."""
+    """Crash-safe JSON publish: write a UNIQUE sibling tmp file
+    (``mkstemp`` — concurrent writers such as the supervisor thread's
+    state_writer and the CLI main thread must never interleave on one
+    tmp name), fsync it, then ``os.replace`` over the target — a kill
+    at ANY instant leaves either the previous complete file or the new
+    complete file, never a torn one. The ``router.state_write`` chaos
+    site fires in the widest kill window (tmp durable, rename not yet
+    done); an armed error must leave the previous file intact."""
     path.parent.mkdir(parents=True, exist_ok=True)
-    tmp = path.with_name(path.name + ".tmp")
-    with open(tmp, "w", encoding="utf-8") as f:
-        f.write(json.dumps(obj, indent=2))
-        f.flush()
-        os.fsync(f.fileno())
+    fd, tmp_name = tempfile.mkstemp(prefix=path.name + ".",
+                                    suffix=".tmp", dir=path.parent)
+    tmp = Path(tmp_name)
     try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            f.write(json.dumps(obj, indent=2))
+            f.flush()
+            os.fchmod(f.fileno(), 0o644)
+            os.fsync(f.fileno())
         FAULTS.fire("router.state_write")
+        os.replace(tmp, path)
     except BaseException:
         try:
             tmp.unlink()
         except OSError:
             pass
         raise
-    os.replace(tmp, path)
 
 
 class RouterStateStore:
@@ -289,6 +296,12 @@ class RouterStateStore:
         self._journal = EventJournal(
             self.dir / "delta-journal", fsync="always",
             max_bytes=max(seg + 1, int(max_bytes)), segment_max_bytes=seg)
+        #: marker writes come from concurrent ``to_thread`` workers
+        #: (delta appends, amnesia floor adoptions for several replicas
+        #: probed at once) — serialize them and never let a slow writer
+        #: regress the published epoch below one already on disk
+        self._marker_mutex = threading.Lock()
+        self._published_epoch = 0
 
     def load(self) -> tuple[int, list[tuple[int, bytes]]]:
         """Durable (epoch floor, [(epoch, raw delta), ...]) oldest-first."""
@@ -306,6 +319,8 @@ class RouterStateStore:
                             payload[8:]))
         if entries:
             epoch = max(epoch, entries[-1][0])
+        with self._marker_mutex:
+            self._published_epoch = max(self._published_epoch, epoch)
         return epoch, entries
 
     def append(self, epoch: int, raw: bytes) -> None:
@@ -326,11 +341,23 @@ class RouterStateStore:
                 self._journal.advance(pos)
                 if self._journal.size_bytes() >= before:
                     raise
+        else:
+            # retry budget exhausted without an append: the delta was
+            # NEVER made durable, so the epoch marker must not be
+            # published (the caller 500s and the updater retries) —
+            # falling through would ack an epoch the journal can't replay
+            raise JournalFull(
+                f"delta journal still full after 64 GC passes "
+                f"(epoch {epoch}, {len(payload)} bytes)")
         self.write_epoch(epoch)
 
     def write_epoch(self, epoch: int) -> None:
-        _atomic_write_json(self._marker, {"epoch": int(epoch),
-                                          "ts": time.time()})
+        with self._marker_mutex:
+            if int(epoch) <= self._published_epoch:
+                return              # a concurrent writer already got further
+            _atomic_write_json(self._marker, {"epoch": int(epoch),
+                                              "ts": time.time()})
+            self._published_epoch = int(epoch)
 
     def close(self) -> None:
         try:
@@ -399,6 +426,13 @@ class FleetRouter:
         #: recent query bodies, the canary replay sample
         self._recent: deque[dict] = deque(maxlen=max(1, recent_ring))
         self._session: aiohttp.ClientSession | None = None
+        #: serializes epoch allocation + durable append + bump: the
+        #: awaited journal write in handle_reload_delta yields to the
+        #: event loop, and two concurrent deltas must never read the
+        #: same fleet_epoch (two deltas journaled under one epoch would
+        #: let a replica that applied only the first report fully
+        #: synced, hiding the second forever)
+        self._epoch_lock = asyncio.Lock()
         self._probe_task: asyncio.Task | None = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self._draining = False
@@ -811,23 +845,30 @@ class FleetRouter:
             return web.json_response(
                 {"message": 'Body must be {"users": {user_id: [factor]}}.'},
                 status=400, headers=headers)
-        epoch = self.fleet_epoch + 1
-        if self._store is not None:
-            # durability BEFORE visibility: the delta is journaled and
-            # the epoch marker published before the in-memory epoch
-            # bumps, so a router killed at any instant either never
-            # acked this epoch or can replay it after restart
-            try:
-                await asyncio.to_thread(self._store.append, epoch, raw)
-            except Exception as e:  # noqa: BLE001 — updater must retry
-                log.exception("durable delta append failed at epoch %d",
-                              epoch)
-                return web.json_response(
-                    {"message": f"router state write failed: {e}"},
-                    status=500, headers=headers)
-        self.fleet_epoch = epoch
-        _M_EPOCH.set(epoch)
-        self._journal.append((epoch, raw))
+        async with self._epoch_lock:
+            # allocate + journal + bump under one lock: the awaited
+            # durable append yields to the event loop, and a concurrent
+            # delta reading the same fleet_epoch would journal two
+            # different deltas under ONE epoch — a replica that applied
+            # only the first would look fully synced and never be
+            # reconciled
+            epoch = self.fleet_epoch + 1
+            if self._store is not None:
+                # durability BEFORE visibility: the delta is journaled
+                # and the epoch marker published before the in-memory
+                # epoch bumps, so a router killed at any instant either
+                # never acked this epoch or can replay it after restart
+                try:
+                    await asyncio.to_thread(self._store.append, epoch, raw)
+                except Exception as e:  # noqa: BLE001 — updater retries
+                    log.exception("durable delta append failed at epoch %d",
+                                  epoch)
+                    return web.json_response(
+                        {"message": f"router state write failed: {e}"},
+                        status=500, headers=headers)
+            self.fleet_epoch = epoch
+            _M_EPOCH.set(epoch)
+            self._journal.append((epoch, raw))
         results: dict[str, dict] = {}
 
         async def _one(r: Replica) -> None:
@@ -842,9 +883,13 @@ class FleetRouter:
                     out = (await resp.json()
                            if resp.status in (200, 400, 503) else {})
                     if resp.status == 200:
-                        r.synced_epoch = epoch
+                        # max(): fan-outs for successive epochs overlap
+                        # (only allocation is serialized), and a slow
+                        # reply for epoch N must not regress a replica
+                        # already synced to N+1
+                        r.synced_epoch = max(r.synced_epoch, epoch)
                         r.reported_epoch = int(out.get("epoch", 0) or 0)
-                        _M_REPLICA_EPOCH.set(epoch, replica=r.name)
+                        _M_REPLICA_EPOCH.set(r.synced_epoch, replica=r.name)
                         _M_FANOUT.inc(replica=r.name, status="ok")
                         results[r.name] = {"ok": True,
                                            "epoch": r.reported_epoch}
@@ -1249,6 +1294,17 @@ _BROODS: list[list[subprocess.Popen]] = []
 _BROOD_ATEXIT = [False]
 
 
+def _prune_broods() -> None:
+    """Drop already-exited children from the atexit sweep's registry.
+    Every supervisor respawn routes through ``spawn_replicas``, so in a
+    long-lived supervised fleet the brood history would otherwise grow
+    one dead Popen per respawn, unbounded. In-place so callers holding
+    a brood list keep seeing their own still-running children."""
+    for procs in _BROODS:
+        procs[:] = [p for p in procs if p.poll() is None]
+    _BROODS[:] = [procs for procs in _BROODS if procs]
+
+
 def _terminate_broods() -> None:
     for procs in _BROODS:
         for proc in procs:
@@ -1307,6 +1363,7 @@ def spawn_replicas(engine_dir: str, n: int, base_port: int,
     Every spawned brood is registered with an atexit sweep that
     terminates still-running children on interpreter exit; each proc
     carries its port as ``proc.pio_port`` for ``reap_replicas``."""
+    _prune_broods()
     procs: list[subprocess.Popen] = []
     child_env = dict(os.environ if env is None else env)
     for i in range(n):

@@ -10,7 +10,10 @@ end-to-end:
 
 - **Reaping** — a poll pass ``Popen.poll()``s every child, so an exited
   replica is reaped immediately (no zombies) and its exit code is
-  logged with its port.
+  logged with its port. A clean exit (rc 0) is operator intent
+  (``pio fleet drain --stop``, a direct ``/stop``) — the replica goes
+  to ``stopped``, never respawned and never counted toward the crash
+  window.
 - **Respawn with jittered exponential backoff** — a crashed replica is
   respawned on its ORIGINAL port (the router's rendezvous hash and the
   fleet state file both key on it), after ``backoff_base_s * 2^(n-1)``
@@ -303,12 +306,25 @@ class FleetSupervisor:
     def _on_death(self, rep: SupervisedReplica, rc: int | None,
                   now: float) -> None:
         rep.last_exit = rc
+        rep.awaiting_ready = False
+        _M_DEATHS.inc(replica=rep.name)
+        if rc == 0:
+            # a clean exit is operator intent (`pio fleet drain --stop`,
+            # a direct /stop), not a crash: respawning would fight the
+            # operator, and repeated graceful stops must never
+            # accumulate toward quarantining a healthy replica. Only
+            # rc != 0 (or a failed exec) enters the crash window.
+            rep.state = "stopped"
+            rep.death_detected = 0.0
+            log.info("replica %s (port %d) exited cleanly; "
+                     "not respawning (operator stop)", rep.name, rep.port)
+            trace_event("supervisor.stop", replica=rep.name)
+            self._write_state()
+            return
         rep.deaths.append(now)
         self._prune_deaths(rep, now)
         rep.death_detected = now
-        rep.awaiting_ready = False
-        _M_DEATHS.inc(replica=rep.name)
-        if rc not in (0, None):
+        if rc is not None:
             log.warning("replica %s (port %d) exited rc=%s "
                         "(death %d/%d in %.0fs window)",
                         rep.name, rep.port, rc, len(rep.deaths),

@@ -34,6 +34,7 @@ import pytest
 import requests
 
 from predictionio_tpu.obs.metrics import METRICS
+from predictionio_tpu.storage.journal import JournalFull
 from predictionio_tpu.workflow import fleet as fleet_mod
 from predictionio_tpu.workflow.faults import FAULTS, FaultInjected
 from predictionio_tpu.workflow.fleet import (
@@ -41,6 +42,7 @@ from predictionio_tpu.workflow.fleet import (
     FleetRouter,
     RouterStateStore,
     create_fleet_app,
+    fleet_state_path,
     read_fleet_state,
     reap_replicas,
     write_fleet_state,
@@ -231,6 +233,26 @@ def test_respawn_fault_counts_as_death_and_backs_off():
         sup.terminate_all()
 
 
+def test_clean_exit_is_operator_stop_not_a_crash():
+    """rc == 0 is operator intent (`pio fleet drain --stop`, a direct
+    /stop): the replica goes to `stopped` — never respawned, never
+    counted toward the crash window, so repeated graceful stops can't
+    quarantine a healthy replica."""
+    writes = []
+    sup = _sup(lambda rep: _crasher(0), max_respawns=2,
+               state_writer=lambda s: writes.append(
+                   [r.state for r in s.replicas]))
+    rep = sup.replica("r0")
+    sup.poll()                          # pending -> initial spawn
+    rep.proc.wait(timeout=10)           # child exits rc=0
+    sup.poll()                          # reap: clean exit observed
+    assert rep.state == "stopped" and rep.last_exit == 0
+    assert len(rep.deaths) == 0         # nothing toward the crash window
+    sup.poll()                          # and it STAYS stopped
+    assert rep.state == "stopped" and rep.respawns == 0
+    assert writes and writes[-1] == ["stopped"]
+
+
 def test_context_manager_terminates_the_whole_brood():
     with _sup(lambda rep: _sleeper(), n=2) as sup:
         assert _poll(lambda: all(r.proc is not None and r.proc.poll() is None
@@ -273,6 +295,26 @@ def test_terminate_broods_sweeps_stranded_children():
         assert p.poll() is not None     # terminated and reaped
     finally:
         fleet_mod._BROODS.remove(brood)
+
+
+def test_prune_broods_drops_exited_children():
+    """Every supervisor respawn routes through spawn_replicas; without
+    pruning, dead Popen references accumulate in _BROODS forever in a
+    long-lived supervised fleet."""
+    live, dead = _sleeper(), _dead_child()
+    brood = [live, dead]
+    all_dead = [_dead_child()]
+    fleet_mod._BROODS.extend([brood, all_dead])
+    try:
+        fleet_mod._prune_broods()
+        assert brood == [live]          # pruned IN PLACE (callers keep
+        assert brood in fleet_mod._BROODS   # their list identity)
+        assert all_dead not in fleet_mod._BROODS
+    finally:
+        live.kill()
+        live.wait(timeout=10)
+        if brood in fleet_mod._BROODS:
+            fleet_mod._BROODS.remove(brood)
 
 
 # ---------------------------------------------------------------------------
@@ -335,6 +377,34 @@ def test_state_write_killed_mid_write_preserves_previous_file(
     assert read_fleet_state()["routerUrl"] == "http://127.0.0.1:9002"
 
 
+def test_concurrent_state_writes_do_not_collide(tmp_path, monkeypatch):
+    """write_fleet_state is called concurrently by the supervisor
+    thread (state_writer on respawn/quarantine) and the CLI main
+    thread: each write must use its OWN tmp file so interleaved
+    writers can't rename each other's tmp out from underneath."""
+    monkeypatch.setenv("PIO_HOME", str(tmp_path))
+    errs: list[BaseException] = []
+
+    def writer(n: int) -> None:
+        try:
+            for _ in range(25):
+                write_fleet_state(
+                    f"http://127.0.0.1:{9000 + n}",
+                    [{"name": "r0", "url": "u0", "pid": None}])
+        except BaseException as e:  # noqa: BLE001 — the test's assertion
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errs, errs[:3]
+    st = read_fleet_state()
+    assert st is not None and st["routerUrl"].startswith("http://127.0.0.1:900")
+    assert not list(fleet_state_path().parent.glob("*.tmp"))
+
+
 def test_pio_fleet_status_reports_stale_state_file(tmp_path):
     env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
     dead = _dead_child().pid
@@ -374,6 +444,59 @@ def test_router_state_store_roundtrip_and_marker_crash(tmp_path):
     (sd / "epoch.json").unlink()
     epoch, entries = RouterStateStore(sd).load()
     assert epoch == 2 and len(entries) == 2
+
+
+def test_write_epoch_never_regresses(tmp_path):
+    """Marker writes come from concurrent to_thread workers (delta
+    appends, amnesia adoptions for several replicas probed at once): a
+    late writer carrying a LOWER epoch must not clobber a marker that
+    already got further."""
+    sd = tmp_path / "rs"
+    store = RouterStateStore(sd)
+    store.write_epoch(3)
+    store.write_epoch(1)                # the slow loser of the race
+    assert json.loads((sd / "epoch.json").read_text())["epoch"] == 3
+    store.close()
+    # and a reopened store seeds its floor from disk via load()
+    store2 = RouterStateStore(sd)
+    assert store2.load()[0] == 3
+    store2.write_epoch(2)
+    assert json.loads((sd / "epoch.json").read_text())["epoch"] == 3
+
+
+def test_router_state_store_append_raises_when_gc_cannot_free(tmp_path):
+    """If the drop-oldest GC loop exhausts its retry budget without
+    ever appending, append must RAISE (handler 500s, updater retries)
+    — never fall through to publishing an epoch marker for a delta
+    that was not made durable."""
+    store = RouterStateStore(tmp_path / "rs")
+
+    class _StuckJournal:
+        """Always full; GC 'frees' a byte per pass, so every retry
+        passes the progress check yet the append never fits."""
+
+        size = 1 << 20
+
+        def append(self, payload):
+            raise JournalFull("still full")
+
+        def peek_batch(self, n):
+            return [b"x"], (0, 0, 0)
+
+        def advance(self, pos):
+            _StuckJournal.size -= 1
+
+        def size_bytes(self):
+            return _StuckJournal.size
+
+        def close(self):
+            pass
+
+    store._journal = _StuckJournal()
+    with pytest.raises(JournalFull):
+        store.append(1, b'{"users": {"a": [1.0]}}')
+    # durability before visibility: no marker for the lost delta
+    assert not (tmp_path / "rs" / "epoch.json").exists()
 
 
 # ---------------------------------------------------------------------------
@@ -439,6 +562,47 @@ def test_router_restart_resumes_durable_epoch_and_replays_journal(tmp_path):
                 s.stop()
             except Exception:  # noqa: BLE001
                 pass
+
+
+def test_concurrent_deltas_get_distinct_epochs(tmp_path):
+    """Two /reload/delta POSTs in flight at once: the awaited durable
+    append yields to the event loop, and without the epoch lock both
+    would read the same fleet_epoch and journal two DIFFERENT deltas
+    under ONE epoch — a replica that applied only the first would look
+    fully synced and the second delta would never be reconciled."""
+    sd = str(tmp_path / "router-state")
+    f = _Fleet(2, router_kw={"state_dir": sd})
+    orig_append = f.router._store.append
+
+    def slow_append(epoch: int, raw: bytes) -> None:
+        time.sleep(0.15)                # widen the allocate->bump window
+        orig_append(epoch, raw)
+
+    f.router._store.append = slow_append
+    epochs: list[int] = []
+
+    def post(n: int) -> None:
+        r = requests.post(f.url + "/reload/delta",
+                          json={"users": {f"c{n}": [0.1, 0.2]}},
+                          timeout=15)
+        assert r.status_code == 200, r.text
+        epochs.append(r.json()["epoch"])
+
+    try:
+        threads = [threading.Thread(target=post, args=(i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(20)
+        assert sorted(epochs) == [1, 2]     # DISTINCT epochs, no reuse
+        assert f.router.fleet_epoch == 2
+        assert [e for e, _ in f.router._journal] == [1, 2]
+    finally:
+        f.close()
+    # and the durable journal agrees: one record per epoch
+    durable_epochs = [e for e, _ in RouterStateStore(sd).load()[1]]
+    assert durable_epochs == [1, 2]
 
 
 def test_replica_ahead_of_router_is_router_amnesia(tmp_path):
