@@ -19,11 +19,15 @@ console/Console.scala:128-1245). Same verb set, no JVM/spark-submit spawning
                   [--journal-partitions N]
   pio adminserver [--port 7071]
   pio dashboard [--port 9000]
-  pio import|export --appid N --input|--output FILE
+  pio import|export [events] --appid N --input|--output FILE
   pio template list|get
   pio status | version
+  pio backup [--backup-dir D] [--keep N] [--full]
+  pio restore [--backup-dir D] [--backup-id N] [--force] [--until TS|SEQ]
   pio admin reap [--stale-after-s N] [--dry-run]
   pio admin metrics [--json]
+  pio admin fsck [--repair] [--json]
+  pio admin gc --blobs [--dry-run]
   pio capture start|stop [--url U] | export DIR --output F
   pio replay CAPTURE_DIR [--target URL | --engine-instance-id ID]
 
@@ -973,6 +977,21 @@ def cmd_bench(args) -> int:
     subprocess — on CPU the virtual device count must be forced via
     XLA_FLAGS before jax initializes, which this (already-jax-importing)
     process cannot do for itself."""
+    if getattr(args, "bench_command", "serve") == "backup":
+        from ..storage.backup import run_backup_bench
+
+        rep = run_backup_bench(files=args.files, size_kb=args.size_kb,
+                               rounds=args.rounds)
+        if args.json:
+            _ok(json.dumps(rep, indent=2, sort_keys=True))
+            return 0
+        _ok(f"backup bench: {rep['files']} files x {rep['sizeKb']}KB")
+        for r in rep["rounds"]:
+            kind = "full" if r["round"] == 0 else "incremental"
+            _ok(f"  round {r['round']} ({kind}): {r['seconds']}s, "
+                f"{r['mbWritten']}MB written ({r['mbPerS']}MB/s), "
+                f"{r['dedupedFiles']} files deduped")
+        return 0
     import subprocess
 
     ways: list = []
@@ -1111,7 +1130,44 @@ def cmd_admin(args) -> int:
     ABANDONED; the same sweep also runs automatically at train start.
     ``pio admin metrics`` dumps this process's telemetry registry —
     counters, gauges, and histogram quantiles (the in-process view of
-    what a server exports at ``GET /metrics``)."""
+    what a server exports at ``GET /metrics``).  ``pio admin fsck``
+    audits the cross-store integrity invariants (blobs, checkpoints,
+    journals, router epoch) and ``pio admin gc --blobs`` reclaims model
+    blobs no non-retired engine instance references."""
+    if args.admin_command == "fsck":
+        from ..storage import backup as drb
+
+        rep = drb.fsck(journal_dir=args.journal_dir,
+                       checkpoint_dir=args.checkpoint_dir,
+                       repair=args.repair)
+        rc = 0 if not rep["violations"] else 1
+        if args.json:
+            _ok(json.dumps(rep, indent=2, sort_keys=True))
+            return rc
+        ck = rep["checked"]
+        _ok(f"fsck: {rep['verdict']} "
+            f"(blobs={ck['blobs']}, checkpoint steps={ck['checkpointSteps']}, "
+            f"journal segments={ck['journalSegments']}, "
+            f"router epoch={'checked' if ck['routerEpoch'] else 'n/a'})")
+        for v in rep["violations"]:
+            mark = "  [repaired]" if v["repaired"] else ""
+            _ok(f"  {v['invariant']}: {v['path']}: {v['detail']}{mark}")
+        if rep["orphanBlobs"]:
+            _ok(f"  {len(rep['orphanBlobs'])} orphan blob(s) — reclaim "
+                f"with `pio admin gc --blobs`")
+        return rc
+    if args.admin_command == "gc":
+        if not args.blobs:
+            _die("nothing to collect: pass --blobs")
+        from ..storage import backup as drb
+
+        rep = drb.gc_blobs(dry_run=args.dry_run)
+        verb = "would delete" if args.dry_run else "deleted"
+        if not rep["orphans"]:
+            _ok("No orphaned model blobs.")
+        for name in rep["orphans"]:
+            _ok(f"  {verb} {name} (+ .sha256 sidecar)")
+        return 0
     from ..workflow.supervisor import heartbeat_age_s, reap_orphans
 
     if args.admin_command == "metrics":
@@ -1527,6 +1583,13 @@ def cmd_status(args) -> int:
     except Exception as e:  # noqa: BLE001
         _ok(f"  completed runs: unavailable ({e})")
     try:
+        from ..storage.backup import status_lines as _dr_status
+
+        for ln in _dr_status():
+            _ok(f"  {ln}")
+    except Exception as e:  # noqa: BLE001
+        _ok(f"  disaster recovery: unavailable ({e})")
+    try:
         import jax
 
         devs = jax.devices()
@@ -1672,18 +1735,84 @@ def cmd_top(args) -> int:
             return 0
 
 
-def cmd_import(args) -> int:
-    from .import_export import import_events
+def cmd_backup(args) -> int:
+    """Consistent, manifest-committed snapshot of every durable store
+    under $PIO_HOME: sqlite databases through the online backup API,
+    everything else behind a post-cut size fence; incremental by
+    default (unchanged files hardlink to the previous complete
+    backup)."""
+    from ..storage import backup as drb
 
-    n = import_events(args.input, args.appid, args.channel)
+    try:
+        rep = drb.create_backup(
+            backup_dir=args.backup_dir, keep=args.keep,
+            mode="full" if args.full else "incremental",
+            journal_dir=args.journal_dir,
+            checkpoint_dir=args.checkpoint_dir)
+    except drb.BackupError as e:
+        _die(str(e))
+    if args.json:
+        _ok(json.dumps(rep, indent=2, sort_keys=True))
+        return 0
+    _ok(f"backup #{rep['seq']} complete ({rep['mode']}"
+        + (f", based on #{rep['basedOn']}" if rep["basedOn"] else "")
+        + f"): {rep['files']} files, {_fmt_bytes(rep['bytes'])} written, "
+          f"{rep['dedupedFiles']} hardlink-deduped, {rep['durationS']}s "
+          f"-> {rep['dir']}")
+    return 0
+
+
+def cmd_restore(args) -> int:
+    """Rebuild a home from a manifest-complete backup: every checksum
+    re-verified before any file lands, refuses a non-empty target
+    without --force (exit 2), then replays the backed-up WAL tail
+    through the id-keyed drain path — point-in-time with --until."""
+    from ..storage import Storage
+    from ..storage import backup as drb
+
+    target = args.target or Storage.home()
+    root = args.backup_dir or str(Path(target) / "backups")
+    try:
+        rep = drb.restore(root, target, backup_id=args.backup_id,
+                          force=args.force, until=args.until,
+                          replay=not args.no_replay)
+    except drb.RestoreRefused as e:
+        _die(str(e), code=2)
+    except drb.BackupError as e:
+        _die(str(e))
+    if args.json:
+        _ok(json.dumps(rep, indent=2, sort_keys=True))
+        return 0
+    for s in rep["skippedPartial"]:
+        _ok(f"warning: backup #{s} is incomplete or corrupt — ignored")
+    cut = " (point-in-time cut applied, WAL tail dropped)" \
+        if rep["walTruncated"] else ""
+    _ok(f"restored backup #{rep['backup']} into {rep['target']}: "
+        f"{rep['files']} files, {_fmt_bytes(rep['bytes'])}, "
+        f"{rep['replayedRecords']} WAL record(s) replayed{cut}")
+    return 0
+
+
+def cmd_import(args) -> int:
+    from .import_export import import_events, resolve_channel
+
+    try:
+        channel = resolve_channel(args.appid, args.channel)
+    except ValueError as e:
+        _die(str(e))
+    n = import_events(args.input, args.appid, channel)
     _ok(f"Imported {n} events to app {args.appid}.")
     return 0
 
 
 def cmd_export(args) -> int:
-    from .import_export import export_events
+    from .import_export import export_events, resolve_channel
 
-    n = export_events(args.output, args.appid, args.channel)
+    try:
+        channel = resolve_channel(args.appid, args.channel)
+    except ValueError as e:
+        _die(str(e))
+    n = export_events(args.output, args.appid, channel)
     _ok(f"Exported {n} events from app {args.appid}.")
     return 0
 
@@ -2112,6 +2241,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="retrieval mode to bench: exact brute-force "
                         "scoring or the quantized ANN index (reports "
                         "recall@k against exact)")
+    x = b_sub.add_parser("backup",
+                         help="synthetic backup throughput: one full "
+                              "backup then incrementals over an "
+                              "unchanged home (dedup should approach "
+                              "100%%)")
+    x.add_argument("--files", type=int, default=64,
+                   help="synthetic blob count (default 64)")
+    x.add_argument("--size-kb", type=int, default=256,
+                   help="bytes per blob in KB (default 256)")
+    x.add_argument("--rounds", type=int, default=2,
+                   help="backups to take; round 0 is full, the rest "
+                        "incremental (default 2)")
+    x.add_argument("--json", action="store_true")
 
     sp = sub.add_parser("undeploy")
     sp.add_argument("--ip", default="localhost")
@@ -2221,6 +2363,50 @@ def build_parser() -> argparse.ArgumentParser:
                          "directory: complete/partial steps, discarded "
                          "partial-save history, per-host shard state")
 
+    sp = sub.add_parser("backup",
+                        help="consistent, manifest-committed snapshot of "
+                             "all durable state under $PIO_HOME "
+                             "(incremental by default)")
+    sp.add_argument("--backup-dir", default=None,
+                    help="backup root (default $PIO_HOME/backups)")
+    sp.add_argument("--keep", type=int, default=5,
+                    help="retain this many manifest-complete backups, "
+                         "drop-oldest (default 5)")
+    sp.add_argument("--full", action="store_true",
+                    help="copy every byte instead of hardlinking files "
+                         "unchanged since the previous complete backup")
+    sp.add_argument("--journal-dir", default=None,
+                    help="also snapshot this ingest WAL directory when it "
+                         "lives outside $PIO_HOME")
+    sp.add_argument("--checkpoint-dir", default=None,
+                    help="also snapshot this training checkpoint "
+                         "directory when it lives outside $PIO_HOME")
+    sp.add_argument("--json", action="store_true")
+
+    sp = sub.add_parser("restore",
+                        help="rebuild $PIO_HOME from a complete backup: "
+                             "re-verifies every checksum, then replays "
+                             "the backed-up WAL tail (point-in-time "
+                             "with --until)")
+    sp.add_argument("--backup-dir", default=None,
+                    help="backup root (default <target>/backups)")
+    sp.add_argument("--backup-id", type=int, default=None,
+                    help="backup sequence number to restore "
+                         "(default: newest complete)")
+    sp.add_argument("--target", default=None,
+                    help="home to restore into (default $PIO_HOME)")
+    sp.add_argument("--force", action="store_true",
+                    help="allow restoring onto a non-empty target; "
+                         "without it a non-empty target exits 2")
+    sp.add_argument("--until", default=None, metavar="TS|SEQ",
+                    help="point-in-time cut: replay the WAL tail only up "
+                         "to this ISO-8601 eventTime or 1-based record "
+                         "ordinal, then drop the rest of the tail")
+    sp.add_argument("--no-replay", action="store_true",
+                    help="restore files only; skip replaying the WAL "
+                         "tail into the event store")
+    sp.add_argument("--json", action="store_true")
+
     sp = sub.add_parser("admin")
     a_sub = sp.add_subparsers(dest="admin_command", required=True)
     x = a_sub.add_parser("reap",
@@ -2247,6 +2433,31 @@ def build_parser() -> argparse.ArgumentParser:
                    help="raw /debug/flight.json instead of the table")
     x.add_argument("--last", type=int, default=20,
                    help="show only the newest N records (default 20)")
+    x = a_sub.add_parser("fsck",
+                         help="audit cross-store integrity: blobs vs "
+                              "checksums, checkpoint manifests vs shards, "
+                              "journal framing/cursors, router epoch "
+                              "marker vs delta journal")
+    x.add_argument("--repair", action="store_true",
+                   help="quarantine corrupt blobs/steps under "
+                        "$PIO_HOME/quarantine, truncate torn journal "
+                        "segments, clamp cursors, re-seat a regressed "
+                        "epoch marker (nothing is deleted)")
+    x.add_argument("--journal-dir", default=None,
+                   help="also audit this ingest WAL directory when it "
+                        "lives outside $PIO_HOME")
+    x.add_argument("--checkpoint-dir", default=None,
+                   help="audit this checkpoint directory instead of "
+                        "$PIO_HOME/checkpoints")
+    x.add_argument("--json", action="store_true",
+                   help="machine-readable report instead of the table")
+    x = a_sub.add_parser("gc",
+                         help="garbage-collect orphaned artifacts")
+    x.add_argument("--blobs", action="store_true",
+                   help="delete model blobs + .sha256 sidecars referenced "
+                        "by no non-retired engine instance")
+    x.add_argument("--dry-run", action="store_true",
+                   help="list what would be deleted without deleting")
 
     sp = sub.add_parser("profile",
                         help="capture accelerator profiler traces")
@@ -2366,13 +2577,21 @@ def build_parser() -> argparse.ArgumentParser:
                          "clear) — for scripts and tests")
 
     sp = sub.add_parser("import")
+    sp.add_argument("what", nargs="?", choices=["events"], default="events",
+                    help="what to import (only 'events'; optional for "
+                         "backward compatibility)")
     sp.add_argument("--appid", type=int, required=True)
-    sp.add_argument("--channel", type=int, default=None)
+    sp.add_argument("--channel", default=None,
+                    help="channel id or name (default: default channel)")
     sp.add_argument("--input", required=True)
 
     sp = sub.add_parser("export")
+    sp.add_argument("what", nargs="?", choices=["events"], default="events",
+                    help="what to export (only 'events'; optional for "
+                         "backward compatibility)")
     sp.add_argument("--appid", type=int, required=True)
-    sp.add_argument("--channel", type=int, default=None)
+    sp.add_argument("--channel", default=None,
+                    help="channel id or name (default: default channel)")
     sp.add_argument("--output", required=True)
 
     sp = sub.add_parser("template")
@@ -2404,6 +2623,8 @@ COMMANDS = {
     "dashboard": cmd_dashboard,
     "status": cmd_status,
     "top": cmd_top,
+    "backup": cmd_backup,
+    "restore": cmd_restore,
     "admin": cmd_admin,
     "profile": cmd_profile,
     "capture": cmd_capture,

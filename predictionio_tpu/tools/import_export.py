@@ -16,7 +16,24 @@ from typing import Iterator
 from .. import native
 from ..storage import EventQuery, Storage, event_from_api_dict, event_to_api_dict
 
-__all__ = ["import_events", "export_events"]
+__all__ = ["import_events", "export_events", "resolve_channel"]
+
+
+def resolve_channel(app_id: int, channel) -> int | None:
+    """The reference console addressed channels by NAME; our storage
+    keys them by id.  Accept either: None passes through, digits are an
+    id, anything else is looked up among the app's channels."""
+    if channel is None:
+        return None
+    s = str(channel).strip()
+    if not s:
+        return None
+    if s.lstrip("-").isdigit():
+        return int(s)
+    for ch in Storage.get_metadata().channel_get_by_appid(app_id):
+        if ch.name == s:
+            return ch.id
+    raise ValueError(f"app {app_id} has no channel named {s!r}")
 
 _BATCH = 2000
 

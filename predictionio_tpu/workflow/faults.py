@@ -125,6 +125,15 @@ Sites instrumented in this repo:
   file is durable but before the rename publishes it (sync site; an
   ``error`` is a kill mid-write — the previous ``fleet.json`` /
   ``epoch.json`` must survive intact and parseable)
+- ``backup.copy``            — in ``storage/backup.create_backup``
+  right before each file enters the snapshot (sync site; a ``hang``
+  plus SIGKILL is a host dying mid-backup — the partial backup has no
+  manifest so it does not exist, and the previous complete backup
+  stays restorable)
+- ``restore.apply``          — in ``storage/backup.restore`` right
+  before each verified file is materialized into the target home
+  (sync site; an ``error`` is a disk filling mid-restore — the
+  backup itself is untouched and the restore can be re-run)
 
 A fault is armed per site with a kind:
 
@@ -186,6 +195,8 @@ SITES: tuple[str, ...] = (
     "replica.blob_pull",
     "supervisor.respawn",
     "router.state_write",
+    "backup.copy",
+    "restore.apply",
 )
 
 #: chaos runs must always be measurable: one counter series per site,

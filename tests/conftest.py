@@ -110,6 +110,13 @@ def pytest_configure(config):
         "workflow/supervise.py, workflow/fleet.py; test_selfheal.py); "
         "shares the chaos guard's SIGALRM timeout and fault cleanup; "
         "select with -m selfheal")
+    config.addinivalue_line(
+        "markers",
+        "dr: disaster-recovery tests (cross-store backup/restore with "
+        "manifest-complete semantics, point-in-time WAL replay, fsck "
+        "invariant audits, and the backup.copy / restore.apply chaos "
+        "sites — storage/backup.py; test_backup.py); shares the chaos "
+        "guard's SIGALRM timeout and fault cleanup; select with -m dr")
 
 
 #: Hard per-test budget for chaos tests. Injected hangs are capped at
@@ -132,7 +139,8 @@ def _chaos_guard(request):
             and request.node.get_closest_marker("multiengine") is None
             and request.node.get_closest_marker("tune") is None
             and request.node.get_closest_marker("fleet") is None
-            and request.node.get_closest_marker("selfheal") is None):
+            and request.node.get_closest_marker("selfheal") is None
+            and request.node.get_closest_marker("dr") is None):
         yield
         return
 

@@ -315,3 +315,55 @@ def test_pio_deploy_help_documents_prewarm_async(tmp_path):
                          capture_output=True, text=True, env=env, timeout=60)
     assert out.returncode == 0
     assert "--prewarm-async" in out.stdout
+
+
+def test_pio_backup_restore_help_documents_dr_flags(tmp_path):
+    """ISSUE 19: the disaster-recovery surface — `pio backup --help` and
+    `pio restore --help` must advertise every knob the Disaster recovery
+    runbook documents."""
+    env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
+    out = subprocess.run([str(REPO / "bin" / "pio"), "backup", "--help"],
+                         capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    for flag in ("--backup-dir", "--keep", "--full"):
+        assert flag in out.stdout, f"{flag} missing from backup --help"
+    out = subprocess.run([str(REPO / "bin" / "pio"), "restore", "--help"],
+                         capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    for flag in ("--backup-dir", "--backup-id", "--force", "--until",
+                 "--target"):
+        assert flag in out.stdout, f"{flag} missing from restore --help"
+
+
+def test_pio_admin_fsck_and_gc_help(tmp_path):
+    """ISSUE 19: `pio admin fsck --help` / `pio admin gc --help`."""
+    env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
+    out = subprocess.run([str(REPO / "bin" / "pio"), "admin", "fsck",
+                          "--help"],
+                         capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    assert "--repair" in out.stdout
+    out = subprocess.run([str(REPO / "bin" / "pio"), "admin", "gc",
+                          "--help"],
+                         capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    for flag in ("--blobs", "--dry-run"):
+        assert flag in out.stdout, f"{flag} missing from admin gc --help"
+
+
+def test_pio_restore_refuses_nonempty_home_exit_2(tmp_path):
+    """ISSUE 19 bugfix pin: `pio restore` onto a non-empty $PIO_HOME
+    without --force must exit 2 (distinct from generic failure 1) and
+    leave the home untouched — the refusal precedes backup selection, so
+    even a bogus --backup-dir still reports the refusal."""
+    home = tmp_path / "home"
+    home.mkdir()
+    (home / "precious.txt").write_text("keep me")
+    env = dict(os.environ, PIO_HOME=str(home), JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [str(REPO / "bin" / "pio"), "restore",
+         "--backup-dir", str(tmp_path / "nope")],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 2, out.stderr
+    assert "not empty" in out.stderr
+    assert (home / "precious.txt").read_text() == "keep me"
