@@ -2346,6 +2346,181 @@ finally:
             "fleet_respawn_to_ready_s": round(respawn_ready_s, 2)}
 
 
+def fleet_observability_overhead_bench() -> dict:
+    """ISSUE 20 gate: the fleet collector must be cheap enough to leave
+    on. Two FleetRouters front the SAME 2-replica pair (echo engine
+    servers in-process — the collector scrapes their real /metrics +
+    /stats.json pages), one with collect_metrics on and one off; the
+    routed-query p50 delta between them is the collector's whole cost,
+    because the merge plane rides the probe loop, never the request
+    path. Same paired-round method as the ISSUE 11 observability gate;
+    HARD GATE: delta within 5% of the collector-off p50 (plus the same
+    50 us loopback-jitter floor). Also gates on the on-router actually
+    having merged both replicas during the run — a gate passed with a
+    dead collector is decoration."""
+    code = r"""
+import asyncio, json, os, sys, tempfile, threading, time, urllib.request
+sys.path.insert(0, os.environ["REPO"])
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+
+from aiohttp import web
+from predictionio_tpu.controller import Engine, EngineParams
+from predictionio_tpu.storage import Storage
+from predictionio_tpu.testing.sample_engine import (
+    SampleAlgoParams, SampleAlgorithm, SampleDataSource,
+    SampleDataSourceParams, SampleQuery, SamplePreparator, SampleServing)
+from predictionio_tpu.workflow import Context, run_train
+from predictionio_tpu.workflow.create_server import (
+    EngineServer, create_engine_server_app)
+from predictionio_tpu.workflow.fleet import FleetRouter, create_fleet_app
+
+class EchoAlgorithm(SampleAlgorithm):
+    query_class = SampleQuery
+
+def make_engine():
+    return Engine(data_source_classes=SampleDataSource,
+                  preparator_classes=SamplePreparator,
+                  algorithm_classes={"echo": EchoAlgorithm},
+                  serving_classes=SampleServing)
+
+Storage.reset()
+for repo in ("METADATA", "EVENTDATA", "MODELDATA"):
+    Storage.configure(repo, "memory")
+engine = make_engine()
+ep = EngineParams(
+    data_source_params=("", SampleDataSourceParams(id=0)),
+    algorithm_params_list=(("echo", SampleAlgoParams(id=1)),))
+iid = run_train(engine, ep, Context(), engine_factory="__main__:make_engine")
+instance = Storage.get_metadata().engine_instance_get(iid)
+
+def start_app(app):
+    loop = asyncio.new_event_loop()
+    ready, holder = threading.Event(), {}
+    async def _start():
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        holder["port"] = runner.addresses[0][1]
+        ready.set()
+    def _run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(_start())
+        loop.run_forever()
+    threading.Thread(target=_run, daemon=True).start()
+    assert ready.wait(30), "server failed to start"
+    return holder["port"]
+
+# -- the shared replica pair: real engine servers with real /metrics ------
+tmp = tempfile.mkdtemp(prefix="pio_bench_fleetobs_")
+replica_ports = [
+    start_app(create_engine_server_app(EngineServer(
+        engine, instance, instrumentation=True,
+        flight_dump_dir=os.path.join(tmp, "flight_%d" % i))))
+    for i in range(2)]
+urls = ["http://127.0.0.1:%d" % p for p in replica_ports]
+
+ports = {}
+for label, flag in (("off", False), ("on", True)):
+    router = FleetRouter(urls, probe_interval_s=0.25, breaker_reset_s=0.5,
+                         dispatch_timeout_s=8.0, collect_metrics=flag)
+    ports[label] = start_app(create_fleet_app(router))
+
+def fleet_stats(label):
+    with urllib.request.urlopen(
+            "http://127.0.0.1:%d/fleet/stats.json" % ports[label],
+            timeout=10) as r:
+        return json.loads(r.read())
+
+deadline = time.monotonic() + 60   # probe loops mark both replicas up
+while time.monotonic() < deadline:
+    if all(len(fleet_stats(label).get("eligible") or []) >= 2
+           for label in ("off", "on")):
+        break
+    time.sleep(0.1)
+else:
+    raise AssertionError("routers never saw both replicas healthy")
+
+import http.client
+BODY = json.dumps({"q": 1}).encode()
+conns = {label: http.client.HTTPConnection("127.0.0.1", port, timeout=30)
+         for label, port in ports.items()}
+def block(label, n):
+    # one keep-alive connection per router: TCP setup out of the loop,
+    # so the p50 measures the routed-dispatch path, not the socket stack
+    out, conn = [], conns[label]
+    for _ in range(n):
+        t0 = time.perf_counter()
+        conn.request("POST", "/queries.json", body=BODY,
+                     headers={"Content-Type": "application/json"})
+        r = conn.getresponse()
+        assert r.status == 200
+        r.read()
+        out.append(time.perf_counter() - t0)
+    return out
+
+for label in ("off", "on"):   # warm: compile, caches, TCP stacks
+    block(label, 100)
+samples, deltas = {"off": [], "on": []}, []
+def p50(xs):
+    s = sorted(xs)
+    return s[len(s) // 2]
+for _ in range(6):            # paired rounds: ambient drift hits both
+    round_p50 = {}
+    for label in ("off", "on"):
+        xs = block(label, 150)
+        samples[label].extend(xs)
+        round_p50[label] = p50(xs)
+    deltas.append(round_p50["on"] - round_p50["off"])
+for label in ("off", "on"):
+    print("FLEETOBS p50_%s %.6f" % (label, p50(samples[label])),
+          flush=True)
+print("FLEETOBS delta %.6f" % p50(deltas), flush=True)
+
+# liveness stamp: the on-router merged both replicas while we measured
+st = fleet_stats("on")
+coll = st.get("collector") or {}
+print("FLEETOBS fresh %d" % int(coll.get("freshReplicas", 0)), flush=True)
+merged = (st.get("merged") or {}).get("counters", {})
+served = sum(v for k, v in merged.items()
+             if k.startswith("pio_queries_total"))
+print("FLEETOBS merged_queries %d" % int(served), flush=True)
+"""
+    rows = {r[0]: r[1:] for r in _run_tagged_child(code, "FLEETOBS", 600)}
+    p50_off = float(rows["p50_off"][0])
+    p50_on = float(rows["p50_on"][0])
+    delta = float(rows["delta"][0])  # median of paired per-round deltas
+    fresh = int(rows["fresh"][0])
+    merged_queries = int(rows["merged_queries"][0])
+    if fresh < 2 or merged_queries <= 0:
+        raise RuntimeError(
+            f"fleet observability gate is vacuous: the collector-on "
+            f"router merged {fresh}/2 fresh replicas and "
+            f"{merged_queries} served queries during the run — the "
+            f"scrape/merge plane was not live while we measured it")
+    # same rationale as the ISSUE 11 gate: paired-round median delta,
+    # 50 us loopback-jitter floor on a sub-ms echo baseline.
+    if delta > p50_off * 0.05 + 5e-5:
+        raise RuntimeError(
+            f"fleet observability overhead gate: the collector adds "
+            f"{delta * 1e6:.0f} us to a {p50_off * 1e3:.3f} ms routed "
+            f"p50 (on={p50_on * 1e3:.3f} ms) — more than 5%; the merge "
+            f"plane must ride the probe loop, never the request path")
+    pct = delta / p50_off * 100.0
+    log(f"fleet observability overhead: routed p50 "
+        f"{p50_off * 1e3:.3f} ms off / {p50_on * 1e3:.3f} ms on, paired "
+        f"delta {delta * 1e6:+.0f} us ({pct:+.1f}%); collector live with "
+        f"{fresh}/2 fresh replicas, {merged_queries} queries merged")
+    return {"fleet_obs_p50_off_ms": round(p50_off * 1e3, 4),
+            "fleet_obs_p50_on_ms": round(p50_on * 1e3, 4),
+            "fleet_obs_delta_us": round(delta * 1e6, 1),
+            "fleet_obs_pct": round(pct, 2),
+            "fleet_obs_fresh_replicas": fresh,
+            "fleet_obs_merged_queries": merged_queries}
+
+
 def _cache_dir() -> str:
     d = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".xla_cache")
     os.makedirs(d, exist_ok=True)
@@ -2718,6 +2893,8 @@ def main() -> None:
         ("multi-variant serving", multi_variant_bench, 600, False),
         ("dispatch pipeline", dispatch_pipeline_bench, 600, False),
         ("serving fleet", serving_fleet_bench, 900, False),
+        ("fleet observability overhead",
+         fleet_observability_overhead_bench, 600, False),
     ]
     if platform != "tpu":
         # the e2e child pins itself to the host backend (PIO_PLATFORM),

@@ -1,0 +1,629 @@
+"""Fleet observability plane: exact cross-replica metric aggregation.
+
+PRs 17–19 made serving a real multi-process fleet, but every PR-5/11/12
+observability surface — ``/metrics``, SLO burn, waterfalls, ``pio top``
+— is per-process. This module gives the router a :class:`FleetCollector`
+that rides the probe loop, scrapes each replica's ``/metrics`` +
+``/stats.json`` (the HTTP lives in ``workflow/fleet.py``; this module is
+pure functions over scraped text so it unit-tests without a socket), and
+merges them **exactly**:
+
+- **counters** sum per (family, label set);
+- **gauges** keep per-replica identity plus min/max/sum rollups (a mean
+  of ``pio_server_mode`` would be meaningless — per-replica is the
+  truth, the rollup is the convenience);
+- **histograms** merge bucket-wise and *bitwise*: every process buckets
+  latency with the same ``DEFAULT_TIME_BUCKETS_S`` table
+  (obs/metrics.py), so summing integer bucket counts and interpolating
+  with the shared :func:`~predictionio_tpu.obs.metrics.quantile_from_counts`
+  reproduces EXACTLY the histogram a single process fed the union of
+  samples would report. No approximation, no averaged percentiles. A
+  bucket-bounds mismatch (version skew during a rolling deploy) drops
+  that family with ``pio_fleet_merge_dropped_total`` — never a crash.
+
+On top of the merged snapshot the collector derives per-replica
+*windowed* signals (qps, p50/p99, error fraction, shed rate — deltas
+between consecutive scrapes, so they describe "now", not the process
+lifetime) and flags **outliers**: a replica whose signal deviates from
+the fleet median beyond ``outlier_band`` (plus a per-signal absolute
+floor, so a 0.2 ms fleet doesn't flag a 0.3 ms replica) gets
+``pio_fleet_outlier{replica,signal}`` = 1.
+
+Staleness contract (collector hygiene): a failed scrape keeps the
+replica's last snapshot; every view stamps it with ``ageSeconds`` and a
+snapshot older than ``stale_after_s`` is excluded from merges, medians
+and the fleet SLO — the surviving replicas keep serving fleet truth
+with no gap.
+"""
+
+from __future__ import annotations
+
+import math
+import statistics
+import threading
+import time
+
+from .metrics import (METRICS, _fmt_labels, _fmt_value,
+                      quantile_from_counts)
+from .slo import merge_slo_summaries
+
+__all__ = [
+    "FleetCollector",
+    "parse_prometheus",
+    "merge_histograms",
+    "fleet_snapshot",
+]
+
+_C_MERGE_DROPPED = METRICS.counter(
+    "pio_fleet_merge_dropped_total",
+    "histogram families dropped from the fleet merge because replicas "
+    "disagree on bucket bounds (version skew)",
+    labelnames=("family",))
+_C_SCRAPE_FAILURES = METRICS.counter(
+    "pio_fleet_scrape_failures_total",
+    "replica metric scrapes that failed or timed out (the last good "
+    "snapshot is kept and ages out)",
+    labelnames=("replica",))
+_G_SCRAPE_AGE = METRICS.gauge(
+    "pio_fleet_scrape_age_seconds",
+    "age of each replica's last successful metrics scrape",
+    labelnames=("replica",))
+_G_OUTLIER = METRICS.gauge(
+    "pio_fleet_outlier",
+    "1 when a replica's windowed signal (p99 / errorFraction / "
+    "shedRate) deviates from the fleet median beyond the outlier band",
+    labelnames=("replica", "signal"))
+_G_FRESH = METRICS.gauge(
+    "pio_fleet_replicas_fresh",
+    "replicas whose metrics snapshot is fresh enough to merge")
+
+#: request outcomes counted as load-shedding rather than errors when
+#: deriving the windowed error fraction from ``pio_queries_total``
+_SHED_STATUSES = frozenset({"shed", "busy", "draining", "throttle"})
+
+#: absolute floors added to the median band per outlier signal, so a
+#: uniformly fast/healthy fleet never flags noise-level deviations
+_SIGNAL_FLOORS = {"p99": 1e-3, "errorFraction": 0.05, "shedRate": 0.05}
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition parsing (v0.0.4, as rendered by obs/metrics).
+
+def _parse_labelset(s: str) -> tuple[tuple[str, str], ...]:
+    """``a="x",b="y"`` (brace-stripped) -> (("a","x"),("b","y")).
+    Handles the renderer's escapes: ``\\\\``, ``\\"``, ``\\n``."""
+    out: list[tuple[str, str]] = []
+    i, n = 0, len(s)
+    while i < n:
+        j = s.index("=", i)
+        name = s[i:j].strip()
+        if j + 1 >= n or s[j + 1] != '"':
+            raise ValueError(f"bad label at {i}: {s!r}")
+        i = j + 2
+        buf: list[str] = []
+        while True:
+            ch = s[i]
+            if ch == "\\":
+                nxt = s[i + 1]
+                buf.append("\n" if nxt == "n" else nxt)
+                i += 2
+            elif ch == '"':
+                i += 1
+                break
+            else:
+                buf.append(ch)
+                i += 1
+        out.append((name, "".join(buf)))
+        if i < n and s[i] == ",":
+            i += 1
+    return tuple(out)
+
+
+def _split_series(line: str):
+    """One sample line -> (metric_name, label tuple, float value)."""
+    brace = line.find("{")
+    space = line.find(" ")
+    if brace != -1 and (space == -1 or brace < space):
+        name = line[:brace]
+        # scan to the closing brace with quote awareness: label values
+        # may contain '}' inside quotes
+        i, in_str, esc = brace + 1, False, False
+        while i < len(line):
+            ch = line[i]
+            if in_str:
+                if esc:
+                    esc = False
+                elif ch == "\\":
+                    esc = True
+                elif ch == '"':
+                    in_str = False
+            elif ch == '"':
+                in_str = True
+            elif ch == "}":
+                break
+            i += 1
+        labels = _parse_labelset(line[brace + 1:i])
+        rest = line[i + 1:].strip()
+    else:
+        name, _, rest = line.partition(" ")
+        labels = ()
+        rest = rest.strip()
+    value_str = rest.split()[0]  # an optional timestamp may follow
+    return name, labels, float("inf" if value_str == "+Inf" else value_str)
+
+
+def parse_prometheus(text: str) -> dict:
+    """Parse one process's ``/metrics`` page back into structure::
+
+        {"counters":   {name: {labels_tuple: value}},
+         "gauges":     {name: {labels_tuple: value}},
+         "histograms": {name: {"bounds": (...), "counts": (raw..., incl
+                        overflow last), "count": int, "sum": float}},
+         "help":       {name: help_text}}
+
+    The derived ``*_summary`` sibling families the renderer emits are
+    skipped (they are views of the histograms, not independent data).
+    Bucket bounds round-trip bitwise: ``repr(float)`` -> ``float()`` is
+    exact, so cross-replica bounds comparison is an exact float compare.
+    Unparseable lines are skipped, never fatal — a half-written page
+    costs one scrape, not the collector.
+    """
+    types: dict[str, str] = {}
+    helps: dict[str, str] = {}
+    counters: dict[str, dict] = {}
+    gauges: dict[str, dict] = {}
+    hist_raw: dict[str, dict] = {}
+
+    def _hist(base: str) -> dict:
+        return hist_raw.setdefault(
+            base, {"buckets": {}, "sum": 0.0, "count": 0})
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3].strip()
+            elif len(parts) >= 3 and parts[1] == "HELP":
+                helps[parts[2]] = parts[3] if len(parts) > 3 else ""
+            continue
+        try:
+            name, labels, value = _split_series(line)
+        except (ValueError, IndexError):
+            continue
+        if types.get(name) == "summary":
+            continue  # quantile lines of a *_summary sibling
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix):
+                base = name[:-len(suffix)]
+                if types.get(base) == "histogram":
+                    h = _hist(base)
+                    if suffix == "_bucket":
+                        le = dict(labels).get("le")
+                        if le is not None:
+                            bound = (math.inf if le == "+Inf"
+                                     else float(le))
+                            h["buckets"][bound] = value
+                    elif suffix == "_sum":
+                        h["sum"] = value
+                    else:
+                        h["count"] = int(value)
+                    break
+                if types.get(base) == "summary":
+                    break
+        else:
+            kind = types.get(name)
+            if kind == "counter":
+                counters.setdefault(name, {})[labels] = value
+            elif kind != "histogram":  # gauge or untyped
+                gauges.setdefault(name, {})[labels] = value
+            continue
+        continue
+
+    histograms: dict[str, dict] = {}
+    for name, h in hist_raw.items():
+        bounds = tuple(sorted(b for b in h["buckets"] if b != math.inf))
+        cum = [h["buckets"][b] for b in bounds]
+        total = int(h["buckets"].get(math.inf, h["count"]))
+        raw: list[int] = []
+        prev = 0.0
+        for c in cum:
+            raw.append(max(0, int(round(c - prev))))
+            prev = c
+        raw.append(max(0, int(round(total - prev))))
+        histograms[name] = {"bounds": bounds, "counts": tuple(raw),
+                            "count": total, "sum": float(h["sum"])}
+    return {"counters": counters, "gauges": gauges,
+            "histograms": histograms, "help": helps}
+
+
+# ---------------------------------------------------------------------------
+# Exact merge.
+
+def merge_histograms(per_replica: dict[str, dict],
+                     on_drop=None) -> dict:
+    """Merge ``{replica: parsed["histograms"]}`` bucket-wise. Families
+    whose bucket bounds differ across replicas are dropped whole (the
+    merged numbers would be lies); ``on_drop(family)`` is told."""
+    merged: dict[str, dict] = {}
+    dropped: set[str] = set()
+    for rep in sorted(per_replica):
+        for name, h in per_replica[rep].items():
+            if name in dropped:
+                continue
+            m = merged.get(name)
+            if m is None:
+                merged[name] = {"bounds": h["bounds"],
+                                "counts": list(h["counts"]),
+                                "count": h["count"], "sum": h["sum"]}
+                continue
+            if m["bounds"] != h["bounds"] or (
+                    len(m["counts"]) != len(h["counts"])):
+                del merged[name]
+                dropped.add(name)
+                if on_drop is not None:
+                    on_drop(name)
+                continue
+            m["counts"] = [a + b for a, b in zip(m["counts"], h["counts"])]
+            m["count"] += h["count"]
+            m["sum"] += h["sum"]
+    for m in merged.values():
+        m["counts"] = tuple(m["counts"])
+    return merged
+
+
+def fleet_snapshot(parsed_by_replica: dict[str, dict],
+                   on_drop=None) -> dict:
+    """The merged JSON view (``/fleet/stats.json`` core)::
+
+        {"counters":   {"name{labels}": summed_value},
+         "gauges":     {"name{labels}": {min,max,sum,byReplica}},
+         "histograms": {name: {count,sum,p50,p95,p99}}}
+
+    Histogram quantiles come from :func:`quantile_from_counts` over the
+    merged integer bucket counts — the same function every process's
+    ``Histogram`` uses, so they equal the union-fed histogram exactly.
+    """
+    counters: dict[str, float] = {}
+    gauges: dict[str, dict] = {}
+    for rep in sorted(parsed_by_replica):
+        parsed = parsed_by_replica[rep]
+        for name, series in parsed["counters"].items():
+            for labels, v in series.items():
+                key = name + _fmt_labels(tuple(n for n, _ in labels),
+                                         tuple(v_ for _, v_ in labels))
+                counters[key] = counters.get(key, 0.0) + v
+        for name, series in parsed["gauges"].items():
+            for labels, v in series.items():
+                key = name + _fmt_labels(tuple(n for n, _ in labels),
+                                         tuple(v_ for _, v_ in labels))
+                g = gauges.setdefault(
+                    key, {"min": v, "max": v, "sum": 0.0, "byReplica": {}})
+                g["min"] = min(g["min"], v)
+                g["max"] = max(g["max"], v)
+                g["sum"] += v
+                g["byReplica"][rep] = v
+    merged_h = merge_histograms(
+        {rep: p["histograms"] for rep, p in parsed_by_replica.items()},
+        on_drop=on_drop)
+    histograms = {
+        name: {
+            "count": m["count"],
+            "sum": m["sum"],
+            "p50": quantile_from_counts(m["bounds"], m["counts"], 0.50),
+            "p95": quantile_from_counts(m["bounds"], m["counts"], 0.95),
+            "p99": quantile_from_counts(m["bounds"], m["counts"], 0.99),
+        }
+        for name, m in sorted(merged_h.items())
+    }
+    return {"counters": dict(sorted(counters.items())),
+            "gauges": dict(sorted(gauges.items())),
+            "histograms": histograms}
+
+
+# ---------------------------------------------------------------------------
+# The collector.
+
+class _ReplicaSample:
+    __slots__ = ("parsed", "stats", "mono", "wall", "scrapes", "failures",
+                 "last_error", "window", "prev_serve", "prev_queries",
+                 "flight_dumps")
+
+    def __init__(self) -> None:
+        self.parsed: dict | None = None
+        self.stats: dict = {}
+        self.mono: float | None = None   # monotonic time of last GOOD scrape
+        self.wall: float | None = None
+        self.scrapes = 0
+        self.failures = 0
+        self.last_error: str | None = None
+        self.window: dict = {}
+        self.prev_serve: tuple | None = None     # (bounds, counts, mono)
+        self.prev_queries: dict | None = None    # labels -> value
+        self.flight_dumps: int | None = None
+
+
+class FleetCollector:
+    """Router-side scrape state + exact merge + outlier flags.
+
+    The router feeds it (:meth:`ingest` on scrape success,
+    :meth:`mark_failed` on failure) from the probe loop; the
+    ``/fleet/*`` handlers and ``pio fleet status`` read the merged
+    views. Thread-safe: the bench drives it from worker threads.
+    """
+
+    #: histogram the windowed p50/p99/qps signals derive from
+    SERVE_HISTOGRAM = "pio_serving_latency_seconds"
+    QUERIES_COUNTER = "pio_queries_total"
+
+    def __init__(self, stale_after_s: float = 10.0,
+                 outlier_band: float = 0.75,
+                 min_window_events: int = 20,
+                 now_fn=time.monotonic, wall_fn=time.time):
+        self.stale_after_s = float(stale_after_s)
+        self.outlier_band = float(outlier_band)
+        self.min_window_events = int(min_window_events)
+        self._now = now_fn
+        self._wall = wall_fn
+        self._lock = threading.Lock()
+        self._samples: dict[str, _ReplicaSample] = {}
+        self._outlier_keys: set[tuple[str, str]] = set()
+        self._dropped_families: set[str] = set()
+
+    # -- feeding -------------------------------------------------------
+    def ingest(self, replica: str, metrics_text: str,
+               stats: dict | None = None) -> bool:
+        """Book one successful scrape. Returns True when the replica's
+        flight recorder fired since the previous scrape (its ``dumps``
+        count advanced) — the router's cue to pull ``/debug/flight.json``
+        and write a correlated fleet incident bundle."""
+        parsed = parse_prometheus(metrics_text)
+        stats = stats or {}
+        now = self._now()
+        with self._lock:
+            s = self._samples.setdefault(replica, _ReplicaSample())
+            prev_dumps = s.flight_dumps
+            self._update_window_locked(s, parsed, now)
+            s.parsed = parsed
+            s.stats = stats
+            s.mono = now
+            s.wall = self._wall()
+            s.scrapes += 1
+            s.last_error = None
+            dumps = ((stats.get("flight") or {}).get("dumps")
+                     if isinstance(stats.get("flight"), dict) else None)
+            if isinstance(dumps, (int, float)):
+                s.flight_dumps = int(dumps)
+            fired = (prev_dumps is not None
+                     and s.flight_dumps is not None
+                     and s.flight_dumps > prev_dumps)
+        self._refresh_meta_gauges()
+        return fired
+
+    def mark_failed(self, replica: str, error: str) -> None:
+        """A scrape failed or timed out: keep the last snapshot (it ages
+        out of merges past ``stale_after_s``), count the failure."""
+        with self._lock:
+            s = self._samples.setdefault(replica, _ReplicaSample())
+            s.failures += 1
+            s.last_error = error
+        _C_SCRAPE_FAILURES.inc(replica=replica)
+        self._refresh_meta_gauges()
+
+    def forget(self, replica: str) -> None:
+        """Drop a replica that left the fleet for good."""
+        with self._lock:
+            self._samples.pop(replica, None)
+
+    def _update_window_locked(self, s: _ReplicaSample, parsed: dict,
+                              now: float) -> None:
+        """Windowed signals: deltas between consecutive scrapes."""
+        window: dict = {}
+        h = parsed["histograms"].get(self.SERVE_HISTOGRAM)
+        if h is not None:
+            if (s.prev_serve is not None and s.mono is not None
+                    and s.prev_serve[0] == h["bounds"]):
+                dt = max(now - s.mono, 1e-9)
+                delta = tuple(max(0, a - b) for a, b
+                              in zip(h["counts"], s.prev_serve[1]))
+                n = sum(delta)
+                window["qps"] = round(n / dt, 3)
+                if n:
+                    window["p50"] = quantile_from_counts(
+                        h["bounds"], delta, 0.50)
+                    window["p99"] = quantile_from_counts(
+                        h["bounds"], delta, 0.99)
+                window["events"] = n
+            s.prev_serve = (h["bounds"], h["counts"])
+        q = parsed["counters"].get(self.QUERIES_COUNTER)
+        if q is not None:
+            cur = {labels: v for labels, v in q.items()}
+            if s.prev_queries is not None and s.mono is not None:
+                total = err = shed = 0.0
+                for labels, v in cur.items():
+                    d = max(0.0, v - s.prev_queries.get(labels, 0.0))
+                    total += d
+                    status = dict(labels).get("status", "")
+                    if status in _SHED_STATUSES:
+                        shed += d
+                    elif status != "ok":
+                        err += d
+                if total > 0:
+                    window["errorFraction"] = round(err / total, 6)
+                    window["shedRate"] = round(shed / total, 6)
+                    window.setdefault("events", int(total))
+            s.prev_queries = cur
+        if window:
+            s.window = window
+
+    # -- views -----------------------------------------------------------
+    def _fresh_locked(self, now: float) -> dict[str, _ReplicaSample]:
+        return {name: s for name, s in self._samples.items()
+                if s.parsed is not None and s.mono is not None
+                and (now - s.mono) <= self.stale_after_s}
+
+    def _refresh_meta_gauges(self) -> None:
+        now = self._now()
+        with self._lock:
+            for name, s in self._samples.items():
+                if s.mono is not None:
+                    _G_SCRAPE_AGE.set(round(now - s.mono, 3), replica=name)
+            _G_FRESH.set(len(self._fresh_locked(now)))
+
+    def _on_drop(self, family: str) -> None:
+        self._dropped_families.add(family)
+        _C_MERGE_DROPPED.inc(family=family)
+
+    def outliers(self) -> dict[str, list[str]]:
+        """{replica: [signal, ...]} — windowed signal beyond the band
+        around the fleet median. Needs >= 2 fresh replicas with enough
+        window traffic; refreshes ``pio_fleet_outlier`` gauges."""
+        now = self._now()
+        with self._lock:
+            fresh = self._fresh_locked(now)
+            windows = {name: dict(s.window) for name, s in fresh.items()
+                       if s.window.get("events", 0) >= self.min_window_events}
+        flags: dict[str, list[str]] = {}
+        for signal, floor in _SIGNAL_FLOORS.items():
+            vals = {name: w[signal] for name, w in windows.items()
+                    if signal in w}
+            if len(vals) < 2:
+                continue
+            median = statistics.median(vals.values())
+            cut = median * (1.0 + self.outlier_band) + floor
+            for name, v in vals.items():
+                if v > cut:
+                    flags.setdefault(name, []).append(signal)
+        live_keys = {(name, signal)
+                     for name, signals in flags.items()
+                     for signal in signals}
+        with self._lock:
+            for key in self._outlier_keys - live_keys:
+                _G_OUTLIER.set(0.0, replica=key[0], signal=key[1])
+            for key in live_keys:
+                _G_OUTLIER.set(1.0, replica=key[0], signal=key[1])
+            self._outlier_keys = live_keys
+        return flags
+
+    def fleet_slo(self, exclude: str | None = None) -> dict:
+        """Merged SLO summary over fresh replicas (exact: raw good/bad
+        counts summed, burn recomputed — see obs/slo.py). ``exclude``
+        drops one replica — the drain policy asks "is the fleet WITHOUT
+        this replica healthy?"."""
+        now = self._now()
+        with self._lock:
+            fresh = self._fresh_locked(now)
+            summaries = [s.stats.get("slo") for name, s in fresh.items()
+                         if name != exclude
+                         and isinstance(s.stats.get("slo"), dict)]
+        return merge_slo_summaries(summaries)
+
+    def fleet_burn(self, exclude: str | None = None) -> float | None:
+        """Max fast-window burn across merged objectives; None when no
+        fresh replica has reported an SLO block yet."""
+        merged = self.fleet_slo(exclude=exclude)
+        if not merged.get("replicas"):
+            return None
+        burns = [o.get("windows", {}).get("5m", {}).get("burnRate", 0.0)
+                 for o in merged.get("objectives", [])]
+        return max(burns) if burns else 0.0
+
+    def replica_view(self) -> dict:
+        """Per-replica scrape state + windowed signals, every entry
+        stamped with ``ageSeconds`` (staleness is visible, not silent)."""
+        now = self._now()
+        with self._lock:
+            out = {}
+            for name, s in sorted(self._samples.items()):
+                age = (round(now - s.mono, 3)
+                       if s.mono is not None else None)
+                out[name] = {
+                    "ageSeconds": age,
+                    "stale": (age is None or age > self.stale_after_s),
+                    "scrapes": s.scrapes,
+                    "failures": s.failures,
+                    "lastError": s.last_error,
+                    "window": dict(s.window),
+                    "flightDumps": s.flight_dumps,
+                }
+            return out
+
+    def stats_json(self) -> dict:
+        """The ``/fleet/stats.json`` body: merged snapshot + per-replica
+        windows + outliers + merged SLO + collector health."""
+        now = self._now()
+        with self._lock:
+            fresh = self._fresh_locked(now)
+            parsed = {name: s.parsed for name, s in fresh.items()}
+        merged = fleet_snapshot(parsed, on_drop=self._on_drop)
+        return {
+            "merged": merged,
+            "replicas": self.replica_view(),
+            "outliers": self.outliers(),
+            "slo": self.fleet_slo(),
+            "collector": {
+                "freshReplicas": len(fresh),
+                "staleAfterSeconds": self.stale_after_s,
+                "outlierBand": self.outlier_band,
+                "droppedFamilies": sorted(self._dropped_families),
+            },
+        }
+
+    def render_prometheus(self) -> str:
+        """``/fleet/metrics``: Prometheus exposition of every fresh
+        replica's counters and gauges with a ``replica`` label appended,
+        the fleet-merged histograms (buckets + exact quantiles), and the
+        collector's own meta families."""
+        now = self._now()
+        with self._lock:
+            fresh = self._fresh_locked(now)
+            parsed = {name: s.parsed for name, s in sorted(fresh.items())}
+        lines: list[str] = []
+        for kind_key, kind in (("counters", "counter"), ("gauges", "gauge")):
+            families: dict[str, list[str]] = {}
+            helps: dict[str, str] = {}
+            for rep, p in parsed.items():
+                for name, series in p[kind_key].items():
+                    fam = families.setdefault(name, [])
+                    helps.setdefault(name, p["help"].get(name, ""))
+                    for labels, v in sorted(series.items()):
+                        label_str = _fmt_labels(
+                            tuple(n for n, _ in labels),
+                            tuple(val for _, val in labels),
+                            extra=(("replica", rep),))
+                        fam.append(f"{name}{label_str} {_fmt_value(v)}")
+            for name in sorted(families):
+                if helps.get(name):
+                    lines.append(f"# HELP {name} {helps[name]}")
+                lines.append(f"# TYPE {name} {kind}")
+                lines.extend(families[name])
+        merged_h = merge_histograms(
+            {rep: p["histograms"] for rep, p in parsed.items()},
+            on_drop=self._on_drop)
+        for name in sorted(merged_h):
+            m = merged_h[name]
+            lines.append(f"# TYPE {name} histogram")
+            cum = 0
+            for b, c in zip(m["bounds"], m["counts"]):
+                cum += c
+                lines.append(
+                    f'{name}_bucket{{le="{_fmt_value(b)}"}} {cum}')
+            lines.append(f'{name}_bucket{{le="+Inf"}} {m["count"]}')
+            lines.append(f"{name}_sum {_fmt_value(float(m['sum']))}")
+            lines.append(f"{name}_count {m['count']}")
+            qn = f"{name}_summary"
+            lines.append(f"# TYPE {qn} summary")
+            for q, lbl in ((0.50, "0.5"), (0.95, "0.95"), (0.99, "0.99")):
+                v = quantile_from_counts(m["bounds"], m["counts"], q)
+                lines.append(f'{qn}{{quantile="{lbl}"}} '
+                             f"{_fmt_value(float(v))}")
+            lines.append(f"{qn}_sum {_fmt_value(float(m['sum']))}")
+            lines.append(f"{qn}_count {m['count']}")
+        self._refresh_meta_gauges()
+        self.outliers()
+        for fam in (_G_SCRAPE_AGE, _G_FRESH, _G_OUTLIER,
+                    _C_SCRAPE_FAILURES, _C_MERGE_DROPPED):
+            lines.extend(fam.render())
+        return "\n".join(lines) + "\n"

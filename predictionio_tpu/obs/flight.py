@@ -67,6 +67,7 @@ class FlightRecorder:
         self._expiries: deque = deque(maxlen=1024)
         self.last_dump_path: str | None = None
         self.last_dump_reason: str | None = None
+        self.last_dump_time: float | None = None  # wall clock, epoch s
         self.dumps = 0
         self._context_fn = None
         self._incident_listeners: list = []
@@ -194,6 +195,7 @@ class FlightRecorder:
         with self._lock:
             self.last_dump_path = path
             self.last_dump_reason = reason
+            self.last_dump_time = payload["wallTime"]
             self.dumps += 1
         _C_DUMPS.inc(reason=reason)
         self._notify_incident(reason, path)
@@ -216,6 +218,9 @@ class FlightRecorder:
                 "dumps": self.dumps,
                 "lastDumpReason": self.last_dump_reason,
                 "lastDumpPath": self.last_dump_path,
+                # wall-clock stamp lets the fleet collector correlate a
+                # replica dump with router-side context for the window
+                "lastDumpTime": self.last_dump_time,
             }
 
     def reset(self) -> None:
@@ -227,6 +232,7 @@ class FlightRecorder:
             self._last_dump.clear()
             self.last_dump_path = None
             self.last_dump_reason = None
+            self.last_dump_time = None
             self.dumps = 0
             self._incident_listeners.clear()
             _G_RECORDS.set(0)

@@ -36,6 +36,7 @@ __all__ = [
     "Gauge",
     "Histogram",
     "DEFAULT_TIME_BUCKETS_S",
+    "quantile_from_counts",
 ]
 
 #: Log-spaced latency boundaries in seconds: 0.1 ms doubling up to
@@ -66,6 +67,35 @@ def _fmt_value(v: float) -> str:
     if isinstance(v, float) and v.is_integer() and abs(v) < 1e15:
         return str(int(v))
     return repr(v)
+
+
+def quantile_from_counts(bounds: tuple[float, ...],
+                         counts: Iterable[int], q: float) -> float:
+    """Interpolated quantile over raw (non-cumulative) bucket counts,
+    ``counts[-1]`` being the overflow bucket. This is THE quantile
+    function of the system: ``Histogram`` delegates to it and the fleet
+    aggregator (obs/aggregate.py) calls it on merged bucket counts, so a
+    merged fleet quantile is bitwise-equal to the quantile a single
+    histogram fed the union of samples would report — exactness by
+    construction, not by approximation."""
+    counts = list(counts)
+    total = sum(counts)
+    if total == 0:
+        return 0.0
+    rank = q * total
+    cum = 0
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        lo = 0.0 if i == 0 else bounds[i - 1]
+        if i >= len(bounds):
+            return bounds[-1]  # overflow: report top boundary
+        hi = bounds[i]
+        if cum + c >= rank:
+            frac = (rank - cum) / c
+            return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        cum += c
+    return bounds[-1]
 
 
 class _Metric:
@@ -221,22 +251,7 @@ class Histogram:
             self._sum += v
 
     def _quantile_locked(self, q: float) -> float:
-        if self._count == 0:
-            return 0.0
-        rank = q * self._count
-        cum = 0
-        for i, c in enumerate(self._counts):
-            if c == 0:
-                continue
-            lo = 0.0 if i == 0 else self.bounds[i - 1]
-            if i >= len(self.bounds):
-                return self.bounds[-1]  # overflow: report top boundary
-            hi = self.bounds[i]
-            if cum + c >= rank:
-                frac = (rank - cum) / c
-                return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
-            cum += c
-        return self.bounds[-1]
+        return quantile_from_counts(self.bounds, self._counts, q)
 
     def quantile(self, q: float) -> float:
         with self._lock:

@@ -35,7 +35,7 @@ from dataclasses import dataclass
 from .metrics import METRICS
 
 __all__ = ["Objective", "SloTracker", "default_objectives",
-           "ingest_objectives"]
+           "ingest_objectives", "merge_slo_summaries"]
 
 _G_BURN = METRICS.gauge(
     "pio_slo_burn_rate",
@@ -187,6 +187,11 @@ class SloTracker:
                 frac = (bad / total) if total else 0.0
                 windows[label] = {
                     "events": total,
+                    # raw integer counts travel with the summary so the
+                    # fleet aggregator can merge EXACTLY (summing the
+                    # rounded fractions below would compound error)
+                    "good": good,
+                    "bad": bad,
                     "badFraction": round(frac, 6),
                     "burnRate": round(frac / o.budget, 4),
                 }
@@ -203,3 +208,74 @@ class SloTracker:
                 entry["thresholdMs"] = round(o.threshold_s * 1e3, 3)
             objectives.append(entry)
         return {"objectives": objectives, "breaching": any_breaching}
+
+
+def _window_raw(win: dict) -> tuple[int, int]:
+    """(good, bad) from one summary window dict. Summaries from this
+    version carry raw counts; a version-skewed replica without them is
+    reconstructed from events * badFraction (rounded — the best the old
+    wire format allows)."""
+    events = int(win.get("events", 0))
+    if "good" in win and "bad" in win:
+        return int(win["good"]), int(win["bad"])
+    bad = int(round(events * float(win.get("badFraction", 0.0))))
+    return events - bad, bad
+
+
+def merge_slo_summaries(summaries: list[dict]) -> dict:
+    """Fleet-truth SLO: sum the raw good/bad counts of per-replica
+    :meth:`SloTracker.summary` blocks per (objective, window) and
+    recompute fractions/burn from the totals — the PR-11 burn engine's
+    arithmetic re-run over merged buckets, not an average of averages.
+
+    Objectives are keyed by name; target/kind/threshold come from the
+    first replica that declares them (the fleet shares one engine
+    build, so these agree except during a rolling deploy — where the
+    first-seen value is as good as any).
+    """
+    merged: dict[str, dict] = {}
+    order: list[str] = []
+    for s in summaries or []:
+        for obj in (s or {}).get("objectives", []):
+            name = obj.get("name")
+            if not name:
+                continue
+            ent = merged.get(name)
+            if ent is None:
+                ent = {"name": name, "kind": obj.get("kind"),
+                       "target": float(obj.get("target", 0.0)),
+                       "windows": {}}
+                if obj.get("thresholdMs") is not None:
+                    ent["thresholdMs"] = obj["thresholdMs"]
+                merged[name] = ent
+                order.append(name)
+            for label, win in (obj.get("windows") or {}).items():
+                good, bad = _window_raw(win)
+                slot = ent["windows"].setdefault(label, [0, 0])
+                slot[0] += good
+                slot[1] += bad
+    objectives = []
+    any_breaching = False
+    for name in order:
+        ent = merged[name]
+        budget = max(1.0 - ent["target"], 1e-9)
+        windows = {}
+        for label, (good, bad) in ent["windows"].items():
+            total = good + bad
+            frac = (bad / total) if total else 0.0
+            windows[label] = {
+                "events": total,
+                "good": good,
+                "bad": bad,
+                "badFraction": round(frac, 6),
+                "burnRate": round(frac / budget, 4),
+            }
+        breaching = windows.get("5m", {}).get("burnRate", 0.0) > 1.0
+        any_breaching = any_breaching or breaching
+        out = {"name": name, "kind": ent["kind"], "target": ent["target"],
+               "windows": windows, "breaching": breaching}
+        if "thresholdMs" in ent:
+            out["thresholdMs"] = ent["thresholdMs"]
+        objectives.append(out)
+    return {"objectives": objectives, "breaching": any_breaching,
+            "replicas": len(summaries or [])}

@@ -34,6 +34,8 @@ __all__ = [
     "new_request_id",
     "set_request_id",
     "span",
+    "spans_from_waterfall",
+    "render_span_tree",
     "trace_event",
 ]
 
@@ -90,3 +92,62 @@ def span(evt: str, *, trace: str | None = None, **fields):
     finally:
         ms = (time.perf_counter() - t0) * 1e3
         trace_event(evt, trace=trace, ms=round(ms, 3), **{**fields, **extra})
+
+
+# ---------------------------------------------------------------------------
+# Span-tree assembly (``pio trace <rid>``): the propagation above makes a
+# request id joinable across processes; these helpers turn the joined
+# pieces — router hop, replica waterfalls, ingest WAL records — into one
+# rendered tree. A node is ``{"label": str, "ms": float|None,
+# "detail": str|None, "children": [node, ...]}``.
+
+def spans_from_waterfall(record: dict, label: str | None = None) -> dict:
+    """One flight-recorder waterfall record (``Waterfall.to_dict()``
+    shape) as a span node: the request wall at the top, one child per
+    attributed stage in canonical order."""
+    stages = record.get("stagesMs") or {}
+    details = []
+    if record.get("status"):
+        details.append(f"status={record['status']}")
+    if record.get("stalledStage"):
+        details.append(f"stalled={record['stalledStage']}")
+    if not record.get("finished", True):
+        details.append("unfinished")
+    return {
+        "label": label or f"{record.get('path', 'serve')} request",
+        "ms": record.get("wallMs"),
+        "detail": " ".join(details) or None,
+        "children": [{"label": s, "ms": ms, "detail": None, "children": []}
+                     for s, ms in stages.items()],
+    }
+
+
+def render_span_tree(nodes: list[dict], title: str | None = None) -> str:
+    """ASCII tree of span nodes, durations right-aligned to the label."""
+    lines: list[str] = []
+    if title:
+        lines.append(title)
+
+    def fmt(node: dict) -> str:
+        parts = [str(node.get("label", "?"))]
+        ms = node.get("ms")
+        if ms is not None:
+            parts.append(f"{float(ms):.3f} ms")
+        if node.get("detail"):
+            parts.append(f"[{node['detail']}]")
+        return "  ".join(parts)
+
+    def walk(node: dict, prefix: str, last: bool, root: bool) -> None:
+        if root:
+            lines.append(fmt(node))
+            child_prefix = ""
+        else:
+            lines.append(f"{prefix}{'└─ ' if last else '├─ '}{fmt(node)}")
+            child_prefix = prefix + ("   " if last else "│  ")
+        kids = node.get("children") or []
+        for i, kid in enumerate(kids):
+            walk(kid, child_prefix, i == len(kids) - 1, False)
+
+    for node in nodes:
+        walk(node, "", True, True)
+    return "\n".join(lines)

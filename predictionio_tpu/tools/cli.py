@@ -25,7 +25,8 @@ console/Console.scala:128-1245). Same verb set, no JVM/spark-submit spawning
   pio backup [--backup-dir D] [--keep N] [--full]
   pio restore [--backup-dir D] [--backup-id N] [--force] [--until TS|SEQ]
   pio admin reap [--stale-after-s N] [--dry-run]
-  pio admin metrics [--json]
+  pio admin metrics [--json] [--url U]
+  pio trace RID [--router-url U | --url U] [--wal-dir D]
   pio admin fsck [--repair] [--json]
   pio admin gc --blobs [--dry-run]
   pio capture start|stop [--url U] | export DIR --output F
@@ -752,6 +753,10 @@ def _fleet_start(args) -> int:
             canary_sample=args.canary_sample,
             canary_max_mismatch=args.canary_max_mismatch,
             state_dir=state_dir,
+            collect_metrics=not args.no_collect_metrics,
+            metrics_stale_after_s=args.metrics_stale_after_s,
+            outlier_band=args.outlier_band,
+            incident_dir=args.incident_dir,
         )
     finally:
         if supervisor is not None:
@@ -798,6 +803,19 @@ def _fleet_status(args) -> int:
             st = json.loads(resp.read().decode())
     except Exception as e:  # noqa: BLE001
         _die(f"fleet router unreachable at {url}: {e}")
+    # ISSUE 20: the merged observability view — windowed p99/qps per
+    # replica and outlier flags. Absent (older router, collector
+    # disabled) the status below simply omits those columns.
+    windows: dict = {}
+    outliers: dict = {}
+    try:
+        with urllib.request.urlopen(f"{url}/fleet/stats.json",
+                                    timeout=5) as resp:
+            fstats = json.loads(resp.read().decode())
+        windows = fstats.get("replicas") or {}
+        outliers = fstats.get("outliers") or {}
+    except Exception:  # noqa: BLE001 — observability must not break status
+        pass
     quarantined = st.get("quarantined") or []
     _ok(f"fleet router {url}: epoch {st['fleetEpoch']}, "
         f"{len(st['eligible'])}/{len(st['replicas'])} replica(s) eligible"
@@ -810,11 +828,22 @@ def _fleet_status(args) -> int:
                 else f"breaker {r['breaker']}" if r["breaker"] != "closed"
                 else "slo-drained" if r["sloDrained"]
                 else "not ready")
+        obs = ""
+        w = (windows.get(r["name"]) or {}).get("window") or {}
+        if w.get("qps") is not None:
+            obs = f", qps {w['qps']:g}"
+        if w.get("p99") is not None:
+            obs += f", p99 {w['p99'] * 1e3:.2f}ms"
+        flagged = outliers.get(r["name"]) or []
+        if flagged:
+            obs += f" [OUTLIER: {','.join(flagged)}]"
+        if (windows.get(r["name"]) or {}).get("stale"):
+            obs += " [metrics stale]"
         _ok(f"  {r['name']} {r['url']}: {r['status']}, "
             f"live={str(r['live']).lower()} ready={str(r['ready']).lower()}, "
             f"epoch {r['syncedEpoch']}/{st['fleetEpoch']} "
             f"(replica patch epoch {r['patchEpoch']}), "
-            f"inflight {r['inflight']} [{mark}]")
+            f"inflight {r['inflight']}{obs} [{mark}]")
     sup = st.get("supervisor")
     if sup:
         for r in sup.get("replicas", []):
@@ -1171,24 +1200,15 @@ def cmd_admin(args) -> int:
     from ..workflow.supervisor import heartbeat_age_s, reap_orphans
 
     if args.admin_command == "metrics":
+        if getattr(args, "url", None):
+            return _admin_metrics_remote(args)
         from ..obs.metrics import METRICS
 
         snap = METRICS.snapshot()
         if args.json:
             _ok(json.dumps(snap, indent=2, sort_keys=True))
             return 0
-        for section in ("counters", "gauges"):
-            vals = snap[section]
-            if vals:
-                _ok(f"{section}:")
-            for name, v in sorted(vals.items()):
-                _ok(f"  {name:56s} {v:g}")
-        if snap["histograms"]:
-            _ok("histograms (seconds):")
-        for name, h in sorted(snap["histograms"].items()):
-            _ok(f"  {name:44s} n={h['count']:<8d} "
-                f"p50={h['p50'] * 1e3:9.3f}ms p95={h['p95'] * 1e3:9.3f}ms "
-                f"p99={h['p99'] * 1e3:9.3f}ms")
+        _print_metrics_snapshot(snap)
         return 0
     if args.admin_command == "flight":
         import urllib.request
@@ -1235,6 +1255,187 @@ def cmd_admin(args) -> int:
             age = heartbeat_age_s(inst)
             _ok(f"  {verb} {inst.id} (engine={inst.engine_id}, last "
                 f"liveness {age:.0f}s ago) -> ABANDONED")
+    return 0
+
+
+def _print_metrics_snapshot(snap: dict) -> None:
+    """The `pio admin metrics` table over a registry-snapshot-shaped
+    dict ({counters, gauges, histograms}) — shared by the in-process,
+    remote single-server and remote fleet-merged paths."""
+    for section in ("counters", "gauges"):
+        vals = snap.get(section) or {}
+        if vals:
+            _ok(f"{section}:")
+        for name, v in sorted(vals.items()):
+            if isinstance(v, dict):
+                # fleet-merged gauge: min/max/sum rollup + per-replica
+                by = v.get("byReplica") or {}
+                reps = " ".join(f"{k}={val:g}"
+                                for k, val in sorted(by.items()))
+                _ok(f"  {name:56s} min={v.get('min', 0):g} "
+                    f"max={v.get('max', 0):g} sum={v.get('sum', 0):g}"
+                    + (f"  ({reps})" if reps else ""))
+            else:
+                _ok(f"  {name:56s} {v:g}")
+    hists = snap.get("histograms") or {}
+    if hists:
+        _ok("histograms (seconds):")
+    for name, h in sorted(hists.items()):
+        _ok(f"  {name:44s} n={h['count']:<8d} "
+            f"p50={h['p50'] * 1e3:9.3f}ms p95={h['p95'] * 1e3:9.3f}ms "
+            f"p99={h['p99'] * 1e3:9.3f}ms")
+
+
+def _admin_metrics_remote(args) -> int:
+    """`pio admin metrics --url <base>`: ISSUE 20 bugfix. Pointed at a
+    fleet router this used to show only the ROUTER PROCESS's registry
+    with no hint a fleet existed; now the fleet surface is detected
+    (GET /fleet/stats.json) and the merged snapshot is printed, with a
+    breadcrumb to /fleet/metrics. A plain engine server (no fleet
+    surface) falls through to its own /metrics page, parsed back into
+    the same table."""
+    import urllib.request
+
+    from ..obs.aggregate import parse_prometheus
+    from ..obs.metrics import _fmt_labels, quantile_from_counts
+
+    base = args.url.rstrip("/")
+    try:
+        with urllib.request.urlopen(f"{base}/fleet/stats.json",
+                                    timeout=10) as r:
+            fstats = json.loads(r.read().decode())
+    except Exception:  # noqa: BLE001 — not a fleet router
+        fstats = None
+    if isinstance(fstats, dict) and isinstance(fstats.get("merged"), dict):
+        merged = fstats["merged"]
+        if args.json:
+            _ok(json.dumps(fstats, indent=2, sort_keys=True))
+            return 0
+        coll = fstats.get("collector") or {}
+        _ok(f"fleet: merged across {coll.get('freshReplicas', '?')} fresh "
+            f"replica(s) — Prometheus exposition at {base}/fleet/metrics")
+        _print_metrics_snapshot(merged)
+        for name, flagged in sorted((fstats.get("outliers") or {}).items()):
+            _ok(f"outlier: {name} [{','.join(flagged)}]")
+        return 0
+    try:
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            text = r.read().decode()
+    except OSError as e:
+        _die(f"metrics unreachable at {base}: {e}")
+    parsed = parse_prometheus(text)
+    snap: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+    for kind in ("counters", "gauges"):
+        for name, series in parsed[kind].items():
+            for labels, v in series.items():
+                key = name + _fmt_labels(tuple(n for n, _ in labels),
+                                         tuple(val for _, val in labels))
+                snap[kind][key] = v
+    for name, h in parsed["histograms"].items():
+        snap["histograms"][name] = {
+            "count": h["count"], "sum": h["sum"],
+            "p50": quantile_from_counts(h["bounds"], h["counts"], 0.50),
+            "p95": quantile_from_counts(h["bounds"], h["counts"], 0.95),
+            "p99": quantile_from_counts(h["bounds"], h["counts"], 0.99),
+        }
+    if args.json:
+        _ok(json.dumps(snap, indent=2, sort_keys=True))
+        return 0
+    _print_metrics_snapshot(snap)
+    return 0
+
+
+def cmd_trace(args) -> int:
+    """ISSUE 20: `pio trace <rid>` — one-command cross-process trace
+    assembly. The X-PIO-Request-ID that already propagates router ->
+    replica -> WAL becomes queryable: the router's /fleet/trace.json
+    joins its hop log with every replica's flight-recorder records for
+    the id, the ingest WAL is scanned for events carrying the id in
+    their ``"t"`` field, and everything renders as one span tree."""
+    import urllib.parse
+    import urllib.request
+
+    from ..obs.trace import render_span_tree, spans_from_waterfall
+
+    rid = args.request_id
+    nodes: list[dict] = []
+    if args.url:
+        # direct engine-server mode: no router join, just this
+        # process's flight recorder
+        base = args.url.rstrip("/")
+        try:
+            with urllib.request.urlopen(f"{base}/debug/flight.json",
+                                        timeout=10) as r:
+                body = json.loads(r.read().decode())
+        except OSError as e:
+            _die(f"engine server unreachable at {base}: {e}")
+        for rec in body.get("records") or []:
+            if isinstance(rec, dict) and rec.get("requestId") == rid:
+                nodes.append(spans_from_waterfall(
+                    rec, label=f"engine {base}"))
+    else:
+        router = _fleet_router_url(args)
+        joined = None
+        try:
+            with urllib.request.urlopen(
+                    f"{router}/fleet/trace.json?rid="
+                    f"{urllib.parse.quote(rid)}", timeout=10) as r:
+                joined = json.loads(r.read().decode())
+        except Exception as e:  # noqa: BLE001 — WAL-only traces still render
+            print(f"[WARN] fleet router unreachable at {router}: {e}",
+                  file=sys.stderr)
+        if joined:
+            replica_recs = dict(joined.get("replicas") or {})
+            for hop in joined.get("router") or []:
+                replica = hop.get("replica")
+                if replica is None:
+                    nodes.append({
+                        "label": "router hop: every attempt failed",
+                        "ms": hop.get("ms"),
+                        "detail": hop.get("error"), "children": []})
+                    continue
+                detail = [f"http {hop.get('http')}"]
+                if hop.get("hedges"):
+                    detail.append(f"hedges={hop['hedges']}")
+                if hop.get("spillover"):
+                    detail.append("spillover")
+                node = {"label": f"router hop -> {replica}",
+                        "ms": hop.get("ms"),
+                        "detail": " ".join(detail),
+                        "children": [
+                            spans_from_waterfall(
+                                rec, label=f"replica {replica}")
+                            for rec in replica_recs.pop(replica, [])]}
+                nodes.append(node)
+            # replica records with no surviving router hop (the hop
+            # ring is bounded) still render, just un-nested
+            for name, recs in sorted(replica_recs.items()):
+                for rec in recs:
+                    nodes.append(spans_from_waterfall(
+                        rec, label=f"replica {name}"))
+    if args.wal_dir:
+        from ..storage.journal import iter_journal_records
+
+        for payload in iter_journal_records(args.wal_dir):
+            try:
+                d = json.loads(payload)
+            except (ValueError, UnicodeDecodeError):
+                continue
+            if not isinstance(d, dict) or d.get("t") != rid:
+                continue
+            e = d.get("e") or {}
+            nodes.append({
+                "label": (f"ingest WAL: {e.get('event', 'event')} "
+                          f"{e.get('entityType', '?')}/"
+                          f"{e.get('entityId', '?')}"),
+                "ms": None,
+                "detail": f"app={d.get('a')} eventTime={e.get('eventTime')}",
+                "children": []})
+    if not nodes:
+        _ok(f"no spans found for request id {rid}")
+        return 1
+    for line in render_span_tree(nodes, title=f"trace {rid}").splitlines():
+        _ok(line)
     return 0
 
 
@@ -1702,24 +1903,95 @@ def _top_frame(stats: dict, prev: tuple[float, int] | None) -> list[str]:
     return lines
 
 
+def _fleet_top_frame(fstats: dict) -> list[str]:
+    """Render one `pio top --fleet` frame from a router
+    /fleet/stats.json body: fleet header (merged qps/p50/p99/SLO) +
+    one row per replica from the windowed signals. Pure function of
+    its input (unit-testable), like _top_frame."""
+    lines: list[str] = []
+    replicas = fstats.get("replicas") or {}
+    merged = fstats.get("merged") or {}
+    serving = (merged.get("histograms") or {}).get(
+        "pio_serving_latency_seconds") or {}
+    qps = sum((r.get("window") or {}).get("qps") or 0.0
+              for r in replicas.values())
+    header = (f"pio top · fleet · epoch {fstats.get('fleetEpoch', '?')} · "
+              f"{len(fstats.get('eligible') or [])}/{len(replicas)} "
+              f"eligible · qps={qps:.1f}")
+    if serving.get("p50") is not None:
+        header += (f" · p50={serving['p50'] * 1e3:.2f}ms "
+                   f"p99={serving['p99'] * 1e3:.2f}ms (merged)")
+    lines.append(header)
+    slo = fstats.get("slo") or {}
+    breaching = [o["name"] for o in slo.get("objectives", [])
+                 if o.get("breaching")]
+    burns = [((o.get("windows") or {}).get("5m") or {}).get("burnRate")
+             for o in slo.get("objectives", [])]
+    burns = [b for b in burns if b is not None]
+    lines.append(
+        f"fleet slo: "
+        f"{'BREACHING ' + ','.join(breaching) if breaching else 'ok'}"
+        + (f" · max 5m burn={max(burns):.2f}x" if burns else "")
+        + f" · over {slo.get('replicas', 0)} replica(s)")
+    outliers = fstats.get("outliers") or {}
+    lines.append(f"{'replica':10s} {'age':>6s} {'qps':>8s} {'p50':>9s} "
+                 f"{'p99':>9s} {'err%':>6s} {'shed%':>6s}  flags")
+    for name in sorted(replicas):
+        r = replicas[name]
+        w = r.get("window") or {}
+        flags = []
+        if r.get("stale"):
+            flags.append("STALE")
+        if outliers.get(name):
+            flags.append("OUTLIER:" + ",".join(outliers[name]))
+        age = r.get("ageSeconds")
+
+        def _ms(v):
+            return f"{v * 1e3:.2f}ms" if v is not None else "-"
+
+        def _pct(v):
+            return f"{v * 100:.1f}" if v is not None else "-"
+
+        qps_s = f"{w['qps']:g}" if w.get("qps") is not None else "-"
+        lines.append(
+            f"{name:10s} {(f'{age:.1f}s' if age is not None else '-'):>6s} "
+            f"{qps_s:>8s} "
+            f"{_ms(w.get('p50')):>9s} {_ms(w.get('p99')):>9s} "
+            f"{_pct(w.get('errorFraction')):>6s} "
+            f"{_pct(w.get('shedRate')):>6s}  {' '.join(flags)}")
+    coll = fstats.get("collector") or {}
+    dropped = coll.get("droppedFamilies") or []
+    if dropped:
+        lines.append(f"merge: DROPPED families (bucket-bounds skew): "
+                     f"{', '.join(dropped)}")
+    return lines
+
+
 def cmd_top(args) -> int:
     """ISSUE 12: `pio top` — one refreshing terminal view combining the
     serving posture (qps/p50/mode/SLO burn from /stats.json), the HBM
-    ledger by component, and train/stream convergence progress."""
+    ledger by component, and train/stream convergence progress.
+    ISSUE 20: `--fleet` points it at a fleet router instead and renders
+    the merged fleet header + per-replica table from /fleet/stats.json."""
     import urllib.request
 
-    url = args.url.rstrip("/") + "/stats.json"
+    suffix = "/fleet/stats.json" if args.fleet else "/stats.json"
+    url = args.url.rstrip("/") + suffix
     prev: tuple[float, int] | None = None
     frames = 0
     while True:
         try:
             with urllib.request.urlopen(url, timeout=10) as r:
                 stats = json.loads(r.read().decode())
-            lines = _top_frame(stats, prev)
-            prev = (time.monotonic(), int(stats.get("requestCount") or 0))
+            if args.fleet:
+                lines = _fleet_top_frame(stats)
+            else:
+                lines = _top_frame(stats, prev)
+                prev = (time.monotonic(),
+                        int(stats.get("requestCount") or 0))
         except OSError as e:
-            lines = [f"pio top · engine server unreachable at "
-                     f"{args.url}: {e}"]
+            lines = [f"pio top · {'fleet router' if args.fleet else 'engine server'}"
+                     f" unreachable at {args.url}: {e}"]
         if not args.once:
             # clear + home, like top(1); plain print for --once so the
             # frame is capturable/testable
@@ -2174,10 +2446,31 @@ def build_parser() -> argparse.ArgumentParser:
                         "delta journal); default "
                         "$PIO_HOME/run/fleet-router — a restarted "
                         "router resumes at the durable epoch floor")
+    x.add_argument("--no-collect-metrics", action="store_true",
+                   help="disable the fleet metric collector (no "
+                        "/fleet/metrics, /fleet/stats.json merge, "
+                        "outlier flags or incident bundles)")
+    x.add_argument("--metrics-stale-after-s", type=float, default=10.0,
+                   help="a replica whose last metrics scrape is older "
+                        "than this is excluded from fleet merges "
+                        "(its snapshot is kept and stamped ageSeconds)")
+    x.add_argument("--outlier-band", type=float, default=0.75,
+                   help="flag a replica pio_fleet_outlier when its "
+                        "windowed p99/errorFraction/shedRate exceeds "
+                        "the fleet median by this fraction")
+    x.add_argument("--incident-dir", default=None,
+                   help="correlated fleet-incident bundles directory "
+                        "(default $PIO_HOME/run/fleet-incidents)")
     x = f_sub.add_parser(
         "status",
-        help="per-replica liveness, readiness, breaker state and patch-"
-             "epoch lag from the router's /fleet.json")
+        help="per-replica liveness, readiness, breaker state, patch-"
+             "epoch lag, windowed p99/qps and outlier flags from the "
+             "router's /fleet.json + /fleet/stats.json",
+        description="Print one row per replica: liveness, readiness, "
+                    "breaker state, patch-epoch lag, windowed qps/p99 "
+                    "from the router's metric collector, [OUTLIER: ...] "
+                    "flags for replicas straying from the fleet median, "
+                    "and [metrics stale] when the last scrape aged out.")
     x.add_argument("--router-url", default=None,
                    help="fleet router base URL (default: the recorded "
                         "$PIO_HOME/run/fleet.json, else "
@@ -2418,10 +2711,17 @@ def build_parser() -> argparse.ArgumentParser:
     x.add_argument("--dry-run", action="store_true",
                    help="list the orphans without changing their status")
     x = a_sub.add_parser("metrics",
-                         help="dump this process's telemetry registry "
-                              "(counters, gauges, histogram quantiles)")
+                         help="dump a telemetry registry (counters, "
+                              "gauges, histogram quantiles): this "
+                              "process's by default, a live server's "
+                              "with --url — a fleet router is detected "
+                              "and the merged fleet snapshot printed")
     x.add_argument("--json", action="store_true",
                    help="machine-readable snapshot instead of the table")
+    x.add_argument("--url", default=None,
+                   help="live server base URL; a fleet router's merged "
+                        "snapshot (/fleet/stats.json) is preferred, a "
+                        "plain engine server's /metrics is parsed")
     x = a_sub.add_parser("flight",
                          help="fetch a live engine server's flight "
                               "recorder: the last N request waterfalls "
@@ -2575,6 +2875,30 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--once", action="store_true",
                     help="render exactly one frame and exit (no screen "
                          "clear) — for scripts and tests")
+    sp.add_argument("--fleet", action="store_true",
+                    help="treat --url as a fleet router and render the "
+                         "merged fleet header + per-replica table from "
+                         "/fleet/stats.json (ISSUE 20)")
+
+    sp = sub.add_parser(
+        "trace",
+        help="cross-process trace assembly: join one X-PIO-Request-ID "
+             "across the fleet router hop, replica stage waterfalls and "
+             "ingest WAL records into one rendered span tree")
+    sp.add_argument("request_id",
+                    help="the X-PIO-Request-ID to assemble (echoed on "
+                         "every response and minted at ingress)")
+    sp.add_argument("--router-url", default=None,
+                    help="fleet router base URL (default: the recorded "
+                         "$PIO_HOME/run/fleet.json, else "
+                         "http://127.0.0.1:8000)")
+    sp.add_argument("--url", default=None,
+                    help="engine server base URL: skip the router join "
+                         "and read this one server's flight recorder "
+                         "directly")
+    sp.add_argument("--wal-dir", default=None,
+                    help="ingest WAL directory to scan for events "
+                         "carrying this request id in their trace field")
 
     sp = sub.add_parser("import")
     sp.add_argument("what", nargs="?", choices=["events"], default="events",
@@ -2623,6 +2947,7 @@ COMMANDS = {
     "dashboard": cmd_dashboard,
     "status": cmd_status,
     "top": cmd_top,
+    "trace": cmd_trace,
     "backup": cmd_backup,
     "restore": cmd_restore,
     "admin": cmd_admin,

@@ -109,6 +109,7 @@ from pathlib import Path
 import aiohttp
 from aiohttp import web
 
+from ..obs.aggregate import FleetCollector
 from ..obs.breaker import breaker_set
 from ..obs.metrics import METRICS
 from ..obs.replay import PROVENANCE_HEADER, diff_tier
@@ -185,6 +186,11 @@ _M_AMNESIA = METRICS.counter(
 _M_EPOCH_FLOOR = METRICS.gauge(
     "pio_fleet_epoch_floor",
     "durable fleet epoch recovered from the state dir at router start")
+_M_INCIDENTS = METRICS.counter(
+    "pio_fleet_incidents_total",
+    "correlated fleet-incident bundles written (a replica flight "
+    "recorder fired; the router joined its dump with routing/breaker "
+    "context)")
 
 
 def _rendezvous(key: str, name: str) -> float:
@@ -399,6 +405,12 @@ class FleetRouter:
         recent_ring: int = 64,
         state_dir: str | os.PathLike | None = None,
         state_max_bytes: int = 16 * 1024 * 1024,
+        collect_metrics: bool = True,
+        metrics_stale_after_s: float = 10.0,
+        scrape_timeout_s: float | None = None,
+        outlier_band: float = 0.75,
+        incident_dir: str | os.PathLike | None = None,
+        incident_cooldown_s: float = 30.0,
     ):
         if not replica_urls:
             raise ValueError("a fleet needs at least one replica URL")
@@ -438,6 +450,23 @@ class FleetRouter:
         self._draining = False
         self._inflight = 0
         self.start_time = time.time()
+        # ISSUE 20: fleet observability plane. The collector rides the
+        # probe loop (scrapes gathered alongside probes, each with its
+        # own timeout) and owns the exact merge; the router keeps the
+        # hop log for /fleet/trace.json and writes correlated incident
+        # bundles when a replica's flight recorder fires.
+        self.collector: FleetCollector | None = (
+            FleetCollector(stale_after_s=metrics_stale_after_s,
+                           outlier_band=outlier_band)
+            if collect_metrics else None)
+        self.scrape_timeout_s = (probe_timeout_s if scrape_timeout_s is None
+                                 else max(0.1, scrape_timeout_s))
+        self.incident_dir = (Path(incident_dir) if incident_dir is not None
+                             else None)
+        self.incident_cooldown_s = max(0.0, incident_cooldown_s)
+        self._last_incident: dict[str, float] = {}
+        #: recent routed hops — the router's side of `pio trace <rid>`
+        self._route_log: deque[dict] = deque(maxlen=512)
         #: attached by `pio fleet start --supervise` — the rolling
         #: restart endpoint delegates here
         self.supervisor = None
@@ -536,8 +565,102 @@ class FleetRouter:
                 log.exception("fleet probe round failed")
 
     async def _probe_all(self) -> None:
-        await asyncio.gather(*(self._probe(r) for r in self.replicas),
-                             return_exceptions=True)
+        tasks = [self._probe(r) for r in self.replicas]
+        if self.collector is not None:
+            # scrapes ride the probe cadence but are separate coroutines
+            # with their own timeout: a hung /metrics page can neither
+            # stall a health probe nor wedge the round
+            tasks += [self._scrape(r) for r in self.replicas]
+        await asyncio.gather(*tasks, return_exceptions=True)
+
+    async def _scrape(self, r: Replica) -> None:
+        """Pull one replica's /metrics + /stats.json into the collector.
+        Failure is handled like a probe failure: the last snapshot is
+        kept (it ages out of merges past ``metrics_stale_after_s``) and
+        the probe loop never crashes."""
+        timeout = aiohttp.ClientTimeout(total=self.scrape_timeout_s)
+        try:
+            async with self._session.get(f"{r.url}/metrics",
+                                         timeout=timeout) as resp:
+                if resp.status != 200:
+                    raise RuntimeError(f"HTTP {resp.status}")
+                text = await resp.text()
+            stats: dict = {}
+            async with self._session.get(f"{r.url}/stats.json",
+                                         timeout=timeout) as resp:
+                if resp.status == 200:
+                    stats = await resp.json()
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:  # noqa: BLE001 — scrape failure is routine
+            self.collector.mark_failed(r.name, f"scrape: {type(e).__name__}")
+            return
+        try:
+            # parse + merge bookkeeping off the event loop: a scrape
+            # must not add latency blips to concurrently routed queries
+            fired = await asyncio.to_thread(
+                self.collector.ingest, r.name, text, stats)
+        except Exception:  # noqa: BLE001 — aggregation never kills probing
+            log.exception("metric ingest failed for %s", r.name)
+            return
+        if fired:
+            await self._fleet_incident(r)
+
+    def _incident_path_dir(self) -> Path:
+        return (self.incident_dir if self.incident_dir is not None
+                else fleet_state_path().parent / "fleet-incidents")
+
+    async def _fleet_incident(self, trigger: Replica) -> None:
+        """A replica's flight recorder fired between scrapes: pull every
+        replica's /debug/flight.json and write ONE correlated bundle
+        with the router-side routing/breaker context for the window."""
+        now = time.monotonic()
+        last = self._last_incident.get(trigger.name)
+        if last is not None and now - last < self.incident_cooldown_s:
+            return
+        self._last_incident[trigger.name] = now
+        flights: dict[str, dict] = {}
+
+        async def _pull(r: Replica) -> None:
+            try:
+                async with self._session.get(
+                        f"{r.url}/debug/flight.json",
+                        timeout=aiohttp.ClientTimeout(
+                            total=self.scrape_timeout_s)) as resp:
+                    if resp.status == 200:
+                        flights[r.name] = await resp.json()
+            except Exception:  # noqa: BLE001 — a dead sibling still bundles
+                pass
+
+        await asyncio.gather(*(_pull(r) for r in self.replicas))
+        bundle = {
+            "trigger": trigger.name,
+            "wallTime": time.time(),
+            "router": {
+                "status": self.status(),
+                "breakers": {r.name: r.breaker for r in self.replicas},
+                "recentRoutes": list(self._route_log)[-64:],
+            },
+            "fleet": {
+                "slo": self.collector.fleet_slo(),
+                "outliers": self.collector.outliers(),
+                "replicas": self.collector.replica_view(),
+            },
+            "replicas": flights,
+        }
+        directory = self._incident_path_dir()
+        path = directory / f"fleet-incident-{int(time.time() * 1e3)}.json"
+        try:
+            await asyncio.to_thread(directory.mkdir, exist_ok=True,
+                                    parents=True)
+            await asyncio.to_thread(_atomic_write_json, path, bundle)
+        except OSError:
+            log.exception("fleet incident bundle write failed")
+            return
+        _M_INCIDENTS.inc()
+        trace_event("fleet.incident", replica=trigger.name, path=str(path))
+        log.warning("fleet incident bundle written: %s (trigger %s)",
+                    path, trigger.name)
 
     async def _probe(self, r: Replica) -> None:
         now = time.monotonic()
@@ -609,7 +732,21 @@ class FleetRouter:
         if self.slo_drain_burn > 0:
             r.slo_burn = _max_burn(body.get("slo"))
             was = r.slo_drained
-            r.slo_drained = r.slo_burn >= self.slo_drain_burn
+            want = r.slo_burn >= self.slo_drain_burn
+            if want and self.collector is not None:
+                # ISSUE 20: the drain signal sees fleet truth. Drain a
+                # burning replica only while the REST of the fleet is
+                # healthy enough to absorb it — when the merged burn of
+                # the other replicas also breaches, the problem is the
+                # fleet (bad deploy, overload), and removing capacity
+                # would make it worse.
+                rest = self.collector.fleet_burn(exclude=r.name)
+                if rest is not None and rest >= self.slo_drain_burn:
+                    want = False
+                    if not was:
+                        trace_event("fleet.slo_drain_hold", replica=r.name,
+                                    burn=r.slo_burn, fleetBurn=rest)
+            r.slo_drained = want
             if r.slo_drained != was:
                 trace_event("fleet.slo_drain", replica=r.name,
                             active=r.slo_drained, burn=r.slo_burn)
@@ -808,6 +945,10 @@ class FleetRouter:
             trace_event("fleet.route", replica=r.name, http=status,
                         hedges=i, spillover=spilled,
                         ms=round(wall * 1e3, 3))
+            self._route_log.append({
+                "rid": rid, "replica": r.name, "http": status,
+                "hedges": i, "spillover": spilled,
+                "ms": round(wall * 1e3, 3), "wallTime": time.time()})
             out_headers = {TRACE_HEADER: rid, FLEET_REPLICA_HEADER: r.name}
             for h in (PROVENANCE_HEADER, VARIANT_HEADER, "Retry-After"):
                 v = resp_headers.get(h)
@@ -818,6 +959,10 @@ class FleetRouter:
                 content_type="application/json", headers=out_headers)
         if hedged:
             _M_HEDGES.inc(outcome="failed")
+        self._route_log.append({
+            "rid": rid, "replica": None, "outcome": "failed",
+            "error": last_why, "ms": round((time.monotonic() - t0) * 1e3, 3),
+            "wallTime": time.time()})
         if deadline is not None and time.monotonic() >= deadline - (
                 self.hedge_floor_ms / 1e3):
             return _fail("deadline",
@@ -1157,6 +1302,72 @@ class FleetRouter:
         return web.Response(text=METRICS.render_prometheus(),
                             content_type="text/plain")
 
+    # -- fleet observability plane (ISSUE 20) ------------------------------
+    async def handle_fleet_metrics(self,
+                                   request: web.Request) -> web.Response:
+        """Prometheus exposition of the whole fleet: per-replica
+        counters/gauges with a ``replica`` label, exactly-merged
+        histograms, and the collector's own meta families."""
+        if self.collector is None:
+            return web.json_response(
+                {"message": "fleet metric collection is disabled "
+                            "(--no-collect-metrics)"}, status=404)
+        text = await asyncio.to_thread(self.collector.render_prometheus)
+        return web.Response(text=text, content_type="text/plain")
+
+    async def handle_fleet_stats(self,
+                                 request: web.Request) -> web.Response:
+        body: dict = {
+            "role": "fleet-router",
+            "fleetEpoch": self.fleet_epoch,
+            "eligible": [r.name for r in self._eligible()],
+        }
+        if self.collector is not None:
+            body.update(await asyncio.to_thread(self.collector.stats_json))
+        else:
+            body["collector"] = None
+        return web.json_response(body)
+
+    async def handle_fleet_slo(self, request: web.Request) -> web.Response:
+        if self.collector is None:
+            return web.json_response(
+                {"message": "fleet metric collection is disabled"},
+                status=404)
+        return web.json_response(self.collector.fleet_slo())
+
+    async def handle_fleet_trace(self,
+                                 request: web.Request) -> web.Response:
+        """Join one request id across the fleet: the router's hop log
+        plus every replica's flight-recorder records for that id. The
+        ``pio trace <rid>`` command renders this (plus local WAL
+        records) as one span tree."""
+        rid = request.query.get("rid", "").strip()
+        if not rid:
+            return web.json_response({"message": "rid= is required"},
+                                     status=400)
+        hops = [h for h in list(self._route_log) if h.get("rid") == rid]
+        replicas: dict[str, list] = {}
+
+        async def _pull(r: Replica) -> None:
+            try:
+                async with self._session.get(
+                        f"{r.url}/debug/flight.json",
+                        timeout=aiohttp.ClientTimeout(
+                            total=self.scrape_timeout_s)) as resp:
+                    if resp.status != 200:
+                        return
+                    body = await resp.json()
+            except Exception:  # noqa: BLE001 — a dead replica has no spans
+                return
+            recs = [rec for rec in (body.get("records") or [])
+                    if isinstance(rec, dict) and rec.get("requestId") == rid]
+            if recs:
+                replicas[r.name] = recs
+
+        await asyncio.gather(*(_pull(r) for r in self.replicas))
+        return web.json_response(
+            {"rid": rid, "router": hops, "replicas": replicas})
+
     async def handle_stop(self, request: web.Request) -> web.Response:
         async def _stop():
             await self.close()
@@ -1185,6 +1396,10 @@ def create_fleet_app(router: FleetRouter) -> web.Application:
     app.router.add_get("/health.json", router.handle_health)
     app.router.add_get("/fleet.json", router.handle_fleet_json)
     app.router.add_get("/metrics", router.handle_metrics)
+    app.router.add_get("/fleet/metrics", router.handle_fleet_metrics)
+    app.router.add_get("/fleet/stats.json", router.handle_fleet_stats)
+    app.router.add_get("/fleet/slo.json", router.handle_fleet_slo)
+    app.router.add_get("/fleet/trace.json", router.handle_fleet_trace)
     app.router.add_get("/reload", router.handle_reload)
     app.router.add_post("/reload/delta", router.handle_reload_delta)
     app.router.add_post("/fleet/drain", router.handle_fleet_drain)

@@ -12,7 +12,7 @@ DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
 export PYTHONPATH="${DIR}${PYTHONPATH:+:$PYTHONPATH}"
 
 MARKERS="chaos or train_chaos or streaming or replay or multiengine \
-or tune or fleet or selfheal or ingest or overload or dr"
+or tune or fleet or selfheal or ingest or overload or dr or obsfleet"
 
 exec env JAX_PLATFORMS=cpu "${PIO_PYTHON:-python3}" -m pytest \
     "${DIR}/tests" -q -m "${MARKERS}" \

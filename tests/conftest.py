@@ -112,6 +112,14 @@ def pytest_configure(config):
         "select with -m selfheal")
     config.addinivalue_line(
         "markers",
+        "obsfleet: fleet observability tests (the router-side "
+        "FleetCollector scrape/merge plane — exact cross-replica metric "
+        "aggregation, fleet SLO, outlier detection, incident bundles and "
+        "cross-process trace assembly — obs/aggregate.py, "
+        "workflow/fleet.py; test_fleet_obs.py); shares the chaos guard's "
+        "SIGALRM timeout and fault cleanup; select with -m obsfleet")
+    config.addinivalue_line(
+        "markers",
         "dr: disaster-recovery tests (cross-store backup/restore with "
         "manifest-complete semantics, point-in-time WAL replay, fsck "
         "invariant audits, and the backup.copy / restore.apply chaos "
@@ -140,6 +148,7 @@ def _chaos_guard(request):
             and request.node.get_closest_marker("tune") is None
             and request.node.get_closest_marker("fleet") is None
             and request.node.get_closest_marker("selfheal") is None
+            and request.node.get_closest_marker("obsfleet") is None
             and request.node.get_closest_marker("dr") is None):
         yield
         return

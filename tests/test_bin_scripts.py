@@ -351,6 +351,63 @@ def test_pio_admin_fsck_and_gc_help(tmp_path):
         assert flag in out.stdout, f"{flag} missing from admin gc --help"
 
 
+def test_pio_fleet_start_help_documents_observability_flags(tmp_path):
+    """ISSUE 20: the fleet observability plane's knobs — collection
+    on/off, staleness window, outlier band, incident-bundle directory —
+    must be on `pio fleet start --help`."""
+    env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [str(REPO / "bin" / "pio"), "fleet", "start", "--help"],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    for flag in ("--no-collect-metrics", "--metrics-stale-after-s",
+                 "--outlier-band", "--incident-dir"):
+        assert flag in out.stdout, f"{flag} missing from fleet start --help"
+
+
+def test_pio_fleet_status_help_mentions_outlier_columns(tmp_path):
+    """ISSUE 20: `pio fleet status` grew windowed p99/qps columns and
+    outlier flags; the help text must say so."""
+    env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [str(REPO / "bin" / "pio"), "fleet", "status", "--help"],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    assert "outlier" in out.stdout.lower()
+    assert "p99" in out.stdout
+
+
+def test_pio_trace_help_documents_join_sources(tmp_path):
+    """ISSUE 20: `pio trace <rid>` joins router hops, replica flight
+    records and ingest WAL entries — every source flag on the help."""
+    env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
+    out = subprocess.run([str(REPO / "bin" / "pio"), "trace", "--help"],
+                         capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    for flag in ("request_id", "--router-url", "--url", "--wal-dir"):
+        assert flag in out.stdout, f"{flag} missing from trace --help"
+
+
+def test_pio_top_help_documents_fleet_flag(tmp_path):
+    env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
+    out = subprocess.run([str(REPO / "bin" / "pio"), "top", "--help"],
+                         capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    assert "--fleet" in out.stdout
+
+
+def test_pio_admin_metrics_help_documents_url_flag(tmp_path):
+    """ISSUE 20 bugfix pin: `pio admin metrics` can be pointed at a live
+    server; against a fleet router it prints the MERGED snapshot."""
+    env = dict(os.environ, PIO_HOME=str(tmp_path), JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [str(REPO / "bin" / "pio"), "admin", "metrics", "--help"],
+        capture_output=True, text=True, env=env, timeout=60)
+    assert out.returncode == 0
+    assert "--url" in out.stdout and "--json" in out.stdout
+    assert "fleet" in out.stdout.lower()
+
+
 def test_pio_restore_refuses_nonempty_home_exit_2(tmp_path):
     """ISSUE 19 bugfix pin: `pio restore` onto a non-empty $PIO_HOME
     without --force must exit 2 (distinct from generic failure 1) and
